@@ -1,0 +1,27 @@
+"""keystone-tpu: a TPU-native large-scale ML pipeline framework.
+
+A from-scratch re-design of the capabilities of KeystoneML
+(reference: /root/reference, Scala/Spark) on JAX/XLA/Pallas:
+
+- Typed ``Transformer``/``Estimator`` nodes compose into a lazy dataflow DAG
+  (an immutable ``Graph`` IR), a Catalyst-style rule engine optimizes the DAG
+  (CSE, dead-branch elimination, cost-model solver selection, profile-driven
+  auto-caching), and a memoizing executor runs it.
+- Instead of Spark RDDs, data lives in ``Dataset``: pytrees of arrays with a
+  leading example axis, shardable over a ``jax.sharding.Mesh``; instead of
+  Spark shuffle/treeReduce, communication is XLA collectives over ICI/DCN.
+- Solvers (block coordinate descent, L-BFGS, TSQR PCA, kernel ridge) are
+  single staged XLA programs over the mesh rather than driver-coordinated
+  loops of cluster jobs.
+"""
+
+__version__ = "0.1.0"
+
+from keystone_tpu.workflow import (  # noqa: F401
+    Estimator,
+    FunctionNode,
+    LabelEstimator,
+    Pipeline,
+    Transformer,
+)
+from keystone_tpu.parallel.dataset import Dataset  # noqa: F401

@@ -1,0 +1,5 @@
+"""Operator library ("nodes"): featurizers, solvers, preprocessing.
+
+Mirrors the reference's nodes/{learning,images,stats,nlp,util} inventory
+(SURVEY.md §2.2-2.6) with TPU-first implementations.
+"""

@@ -1,0 +1,16 @@
+from keystone_tpu.ops.util.cacher import Cacher  # noqa: F401
+from keystone_tpu.ops.util.nodes import (  # noqa: F401
+    AllSparseFeatures,
+    ClassLabelIndicators,
+    ClassLabelIndicatorsFromIntArrayLabels,
+    CommonSparseFeatures,
+    Densify,
+    FloatToDouble,
+    MatrixVectorizer,
+    MaxClassifier,
+    Shuffler,
+    Sparsify,
+    TopKClassifier,
+    VectorCombiner,
+    VectorSplitter,
+)

@@ -1,0 +1,33 @@
+"""Cacher — identity transformer that materializes its input.
+
+Reference: nodes/util/Cacher.scala. Doubles as the marker the optimizer's
+ExtractSaveablePrefixes rule uses to decide which intermediate results are
+worth persisting in the cross-pipeline prefix state.
+
+On TPU, "cache" means: force the lazy batched computation now and keep the
+resulting device buffers, so downstream consumers (and the auto-cache rule's
+run-count analysis) see a materialized array instead of recomputing the
+upstream chain.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.workflow.api import Transformer
+
+
+@dataclasses.dataclass(eq=False)
+class Cacher(Transformer):
+    name: str = ""
+
+    def apply(self, x: Any) -> Any:
+        return x
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        return ds.cache()
+
+    def eq_key(self):
+        return ("cacher", self.name, id(self) if not self.name else None)

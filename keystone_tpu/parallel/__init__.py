@@ -1,0 +1,17 @@
+"""Distributed substrate: device mesh, sharding helpers, collectives, Dataset.
+
+This layer replaces the reference's Spark runtime (RDDs, broadcast, shuffle,
+treeReduce — SURVEY.md §2.10) with JAX-native equivalents: a
+``jax.sharding.Mesh`` over TPU chips, ``NamedSharding`` annotations that let
+XLA insert ICI/DCN collectives, and a ``Dataset`` container whose leading
+example axis is sharded over the mesh's data axis.
+"""
+
+from keystone_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    current_mesh,
+    make_mesh,
+    use_mesh,
+)
+from keystone_tpu.parallel.dataset import Dataset  # noqa: F401

@@ -1,0 +1,228 @@
+"""``Dataset`` — the framework's N-example collection type (the RDD stand-in).
+
+Two physical modes:
+
+- **array mode**: a pytree of arrays (usually one matrix) with a leading
+  example axis, optionally zero-padded to a multiple of the mesh's data-shard
+  count and placed with a ``NamedSharding`` on the data axis. This is the fast
+  path: transformers become batched jnp ops, solvers see one sharded matrix,
+  XLA inserts the collectives.
+- **items mode**: a host-side list of per-example Python objects (ragged
+  arrays, images of varying size, token lists). This replaces RDDs of
+  non-uniform records; operators map over it on host and convert to array
+  mode as soon as shapes become uniform.
+
+Padding discipline: ``n`` is the valid example count; rows past ``n`` are
+zeros. Reductions that care divide by ``n`` or use ``mask()``; zero rows
+contribute nothing to Gram matrices / sums, so linear solvers are exact
+without explicit masking.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.parallel import mesh as mesh_lib
+
+
+def _leading_dim(tree: Any) -> int:
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        raise ValueError("empty pytree")
+    return leaves[0].shape[0]
+
+
+class Dataset:
+    def __init__(
+        self,
+        *,
+        arrays: Any = None,
+        items: Optional[List[Any]] = None,
+        n: Optional[int] = None,
+    ):
+        if (arrays is None) == (items is None):
+            raise ValueError("exactly one of arrays/items required")
+        self._arrays = arrays
+        self._items = items
+        if arrays is not None:
+            self._n = int(n) if n is not None else _leading_dim(arrays)
+        else:
+            self._n = len(items)
+        self._cached = False
+
+    # -- constructors ------------------------------------------------------
+
+    @staticmethod
+    def of(data: Any) -> "Dataset":
+        """Lift a list/array into a Dataset (lists -> items mode unless all
+        leaves are uniform arrays, arrays -> array mode)."""
+        if isinstance(data, Dataset):
+            return data
+        if isinstance(data, (list, tuple)):
+            return Dataset(items=list(data))
+        return Dataset(arrays=jnp.asarray(data))
+
+    @staticmethod
+    def from_array(arrays: Any, n: Optional[int] = None) -> "Dataset":
+        return Dataset(arrays=arrays, n=n)
+
+    @staticmethod
+    def from_items(items: Sequence[Any]) -> "Dataset":
+        return Dataset(items=list(items))
+
+    # -- inspection --------------------------------------------------------
+
+    @property
+    def n(self) -> int:
+        return self._n
+
+    def __len__(self) -> int:
+        return self._n
+
+    @property
+    def is_array(self) -> bool:
+        return self._arrays is not None
+
+    @property
+    def padded_n(self) -> int:
+        if self.is_array:
+            return _leading_dim(self._arrays)
+        return self._n
+
+    # -- views -------------------------------------------------------------
+
+    def padded(self) -> Any:
+        """Arrays with the (possibly padded) leading axis — the solver view."""
+        return self.to_array_mode()._arrays
+
+    def array(self) -> Any:
+        """Arrays sliced to exactly ``n`` valid rows (unsharded host view)."""
+        arrs = self.to_array_mode()._arrays
+        if _leading_dim(arrs) == self._n:
+            return arrs
+        return jax.tree_util.tree_map(lambda a: a[: self._n], arrs)
+
+    def mask(self) -> jnp.ndarray:
+        """(padded_n,) float32 validity mask."""
+        pn = self.padded_n
+        return (jnp.arange(pn) < self._n).astype(jnp.float32)
+
+    def items(self) -> List[Any]:
+        if self._items is not None:
+            return self._items
+        arrs = self.array()
+        host = jax.tree_util.tree_map(np.asarray, arrs)
+        return [
+            jax.tree_util.tree_map(lambda a, i=i: a[i], host)
+            for i in range(self._n)
+        ]
+
+    def __iter__(self):
+        return iter(self.items())
+
+    def first(self) -> Any:
+        if self._items is not None:
+            return self._items[0]
+        return jax.tree_util.tree_map(lambda a: a[0], self.array())
+
+    def take(self, k: int) -> List[Any]:
+        return self.items()[:k]
+
+    # -- conversions -------------------------------------------------------
+
+    def to_array_mode(self) -> "Dataset":
+        if self.is_array:
+            return self
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *self._items
+        )
+        return Dataset(arrays=stacked, n=self._n)
+
+    # -- transforms (eager; graph-level laziness lives in Expressions) -----
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        """Per-example host map (items mode result)."""
+        return Dataset(items=[fn(x) for x in self.items()])
+
+    def map_arrays(self, fn: Callable[[Any], Any]) -> "Dataset":
+        """Whole-batch array transform; ``fn`` must preserve the leading axis
+        and map zero pad rows to values safe to keep as padding."""
+        return Dataset(arrays=fn(self.padded()), n=self._n)
+
+    def flat_map(self, fn: Callable[[Any], Sequence[Any]]) -> "Dataset":
+        out: List[Any] = []
+        for x in self.items():
+            out.extend(fn(x))
+        return Dataset(items=out)
+
+    def filter(self, pred: Callable[[Any], bool]) -> "Dataset":
+        return Dataset(items=[x for x in self.items() if pred(x)])
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        if self.n != other.n:
+            raise ValueError(f"zip length mismatch: {self.n} vs {other.n}")
+        if self.is_array and other.is_array:
+            pn = max(self.padded_n, other.padded_n)
+            a = self._pad_to(pn)._arrays
+            b = other._pad_to(pn)._arrays
+            return Dataset(arrays=(a, b), n=self.n)
+        return Dataset(
+            items=list(zip(self.items(), other.items()))
+        )
+
+    def _pad_to(self, pn: int) -> "Dataset":
+        arrs = self.to_array_mode()._arrays
+        cur = _leading_dim(arrs)
+        if cur == pn:
+            return self.to_array_mode()
+        if cur > pn:
+            raise ValueError("cannot shrink padding")
+        pad = pn - cur
+        padded = jax.tree_util.tree_map(
+            lambda a: jnp.concatenate(
+                [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)]
+            ),
+            arrs,
+        )
+        return Dataset(arrays=padded, n=self._n)
+
+    # -- placement ---------------------------------------------------------
+
+    def shard(self, mesh=None) -> "Dataset":
+        """Pad to a multiple of the data-shard count and place the leading
+        axis over the mesh's data axis."""
+        mesh = mesh or mesh_lib.current_mesh()
+        nshards = mesh.shape[mesh_lib.DATA_AXIS]
+        ds = self.to_array_mode()
+        pn = -(-ds.padded_n // nshards) * nshards
+        ds = ds._pad_to(pn)
+        sharded = jax.tree_util.tree_map(
+            lambda a: jax.device_put(
+                a, mesh_lib.data_sharding(mesh, ndim=a.ndim)
+            ),
+            ds._arrays,
+        )
+        return Dataset(arrays=sharded, n=self._n)
+
+    def cache(self) -> "Dataset":
+        """Materialize device buffers now (reference: Cacher / rdd.cache)."""
+        if self.is_array:
+            jax.block_until_ready(self._arrays)
+        self._cached = True
+        return self
+
+    @property
+    def is_cached(self) -> bool:
+        return self._cached
+
+    def __repr__(self) -> str:
+        if self.is_array:
+            shapes = jax.tree_util.tree_map(
+                lambda a: tuple(a.shape), self._arrays
+            )
+            return f"Dataset(array, n={self._n}, shapes={shapes})"
+        return f"Dataset(items, n={self._n})"

@@ -1,0 +1,489 @@
+"""Typed user-facing pipeline API.
+
+Reference semantics: workflow/{Transformer,Estimator,LabelEstimator,Chainable,
+Pipeline,PipelineResult,PipelineDataset,PipelineDatum,FittedPipeline}.scala and
+GatherTransformerOperator.scala, re-designed for JAX:
+
+- ``Transformer.apply(x)`` is a pure function on arrays; the batch path
+  defaults to ``vmap`` over the dataset's example axis when data is in array
+  mode (one XLA program over the sharded batch) and a host map otherwise.
+- ``Pipeline.fit()`` executes estimator fits (memoized by structural prefix
+  across pipelines — the "do not fit estimators multiple times" guarantee)
+  and returns a serializable ``FittedPipeline`` whose steady-state apply path
+  can be staged into a single jit-compiled function (``FittedPipeline.jit``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.workflow.executor import GraphExecutor, PipelineEnv
+from keystone_tpu.workflow.expressions import (
+    DatasetExpression,
+    DatumExpression,
+)
+from keystone_tpu.workflow.graph import (
+    EMPTY_GRAPH,
+    Graph,
+    NodeId,
+    SinkId,
+    SourceId,
+    linearize,
+)
+from keystone_tpu.workflow.operators import (
+    DatasetOperator,
+    DatumOperator,
+    DelegatingOperator,
+    EstimatorOperator,
+    Operator,
+    TransformerOperator,
+)
+from keystone_tpu.workflow.rules import UnusedBranchRemovalRule
+
+
+def _hashable(v: Any) -> Any:
+    if isinstance(v, np.ndarray):
+        return (v.shape, str(v.dtype), v.tobytes())
+    if isinstance(v, jax.Array):
+        a = np.asarray(v)
+        return (a.shape, str(a.dtype), a.tobytes())
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        return id(v)
+
+
+class Chainable:
+    """Anything composable into a pipeline via ``and_then``."""
+
+    def to_pipeline(self) -> "Pipeline":
+        raise NotImplementedError
+
+    def and_then(
+        self,
+        nxt: Union["Chainable", "Estimator", "LabelEstimator"],
+        data: Any = None,
+        labels: Any = None,
+    ) -> "Pipeline":
+        pipe = self.to_pipeline()
+        if isinstance(nxt, LabelEstimator):
+            if data is None or labels is None:
+                raise TypeError("LabelEstimator chaining needs data and labels")
+            return pipe._concat(nxt.with_data(pipe(data), labels))
+        if isinstance(nxt, Estimator):
+            if data is None:
+                raise TypeError("Estimator chaining needs data")
+            return pipe._concat(nxt.with_data(pipe(data)))
+        return pipe._concat(nxt.to_pipeline())
+
+    def __call__(self, data: Any) -> "PipelineResult":
+        return self.to_pipeline().apply(data)
+
+    def apply(self, data: Any) -> "PipelineResult":
+        return self.to_pipeline().apply(data)
+
+
+class Pipeline(Chainable):
+    """A (GraphExecutor, source, sink) triple — one dangling input, one
+    output. Applying data splices it in place of the source; execution stays
+    lazy until ``PipelineResult.get()``."""
+
+    def __init__(self, executor: GraphExecutor, source: SourceId, sink: SinkId):
+        self.executor = executor
+        self.source = source
+        self.sink = sink
+
+    # -- construction ------------------------------------------------------
+
+    @property
+    def _graph(self) -> Graph:
+        return self.executor.raw_graph
+
+    def to_pipeline(self) -> "Pipeline":
+        return self
+
+    def _concat(self, nxt: "Pipeline") -> "Pipeline":
+        g, _, sink_map = self._graph.connect_graph(
+            nxt._graph, {nxt.source: self.sink}
+        )
+        return Pipeline(GraphExecutor(g), self.source, sink_map[nxt.sink])
+
+    # -- application -------------------------------------------------------
+
+    def apply(self, data: Any) -> "PipelineResult":
+        if isinstance(data, PipelineDataset):
+            g, _, sink_map = data._graph.connect_graph(
+                self._graph, {self.source: data._sink}
+            )
+            return PipelineDataset(GraphExecutor(g), sink_map[self.sink])
+        if isinstance(data, PipelineDatum):
+            g, _, sink_map = data._graph.connect_graph(
+                self._graph, {self.source: data._sink}
+            )
+            return PipelineDatum(GraphExecutor(g), sink_map[self.sink])
+        if isinstance(data, Dataset) or isinstance(data, (list,)) or (
+            hasattr(data, "ndim") and data.ndim >= 2
+        ):
+            return self.apply(PipelineDataset.of(Dataset.of(data)))
+        return self.apply_datum(data)
+
+    def apply_datum(self, datum: Any) -> "PipelineDatum":
+        g, nid = self._graph.add_node(DatumOperator(datum), ())
+        g = g.replace_dependency(self.source, nid)
+        g = g.remove_source(self.source)
+        return PipelineDatum(GraphExecutor(g), self.sink)
+
+    # -- training ----------------------------------------------------------
+
+    def fit(self) -> "FittedPipeline":
+        """Execute every estimator fit (prefix-memoized), swap delegating
+        nodes for the fit transformers, prune, freeze."""
+        executor = self.executor
+        g = executor.graph  # optimized
+        for n in sorted(g.operators.keys()):
+            if isinstance(g.operators[n], DelegatingOperator):
+                deps = g.dependencies[n]
+                est_dep = deps[0]
+                fit_transformer = executor.execute(est_dep).get()
+                if not isinstance(fit_transformer, TransformerOperator):
+                    raise TypeError(
+                        f"estimator fit returned {type(fit_transformer)}"
+                    )
+                g = g.set_operator(n, fit_transformer)
+                g = g.set_dependencies(n, deps[1:])
+        # keep only the apply path from source to sink
+        g_pruned, _ = UnusedBranchRemovalRule().apply(
+            Graph(
+                sources=g.sources,
+                sink_dependencies={self.sink: g.sink_dependencies[self.sink]},
+                operators=g.operators,
+                dependencies=g.dependencies,
+            ),
+            {},
+        )
+        for n, op in g_pruned.operators.items():
+            if not isinstance(op, TransformerOperator):
+                raise TypeError(
+                    f"fit pipeline contains non-transformer node {n}: {op!r}"
+                )
+        return FittedPipeline(g_pruned, self.source, self.sink)
+
+    # -- combinators -------------------------------------------------------
+
+    @staticmethod
+    def gather(branches: Sequence[Chainable]) -> "Pipeline":
+        """Merge N single-input branches onto one shared source; output per
+        example is the tuple of branch outputs (reference: Pipeline.gather +
+        GatherTransformerOperator)."""
+        g, src = EMPTY_GRAPH.add_source()
+        ends: List = []
+        for branch in branches:
+            bp = branch.to_pipeline()
+            g, smap, kmap = g.add_graph(bp._graph)
+            g = g.replace_dependency(smap[bp.source], src)
+            g = g.remove_source(smap[bp.source])
+            end = g.sink_dependencies[kmap[bp.sink]]
+            g = g.remove_sink(kmap[bp.sink])
+            ends.append(end)
+        g, gather_node = g.add_node(GatherTransformerOperator(), ends)
+        g, sink = g.add_sink(gather_node)
+        return Pipeline(GraphExecutor(g), src, sink)
+
+    def to_dot(self) -> str:
+        return self._graph.to_dot()
+
+
+class PipelineResult:
+    """Lazily executed sink value."""
+
+    def __init__(self, executor: GraphExecutor, sink: SinkId):
+        self._executor = executor
+        self._sink = sink
+        self._result: Any = None
+        self._done = False
+
+    @property
+    def _graph(self) -> Graph:
+        return self._executor.raw_graph
+
+    def get(self) -> Any:
+        if not self._done:
+            self._result = self._executor.execute(self._sink).get()
+            self._done = True
+        return self._result
+
+
+class PipelineDataset(PipelineResult):
+    def get(self) -> Dataset:
+        return super().get()
+
+    @staticmethod
+    def of(dataset: Dataset) -> "PipelineDataset":
+        g, nid = EMPTY_GRAPH.add_node(DatasetOperator(dataset), ())
+        g, sink = g.add_sink(nid)
+        return PipelineDataset(GraphExecutor(g), sink)
+
+
+class PipelineDatum(PipelineResult):
+    @staticmethod
+    def of(datum: Any) -> "PipelineDatum":
+        g, nid = EMPTY_GRAPH.add_node(DatumOperator(datum), ())
+        g, sink = g.add_sink(nid)
+        return PipelineDatum(GraphExecutor(g), sink)
+
+
+class Transformer(Chainable, TransformerOperator):
+    """A pure per-example function, liftable to a one-node pipeline.
+
+    Subclasses override ``apply(x)``; override ``apply_batch(ds)`` for a
+    hand-batched path (most array ops should — one matmul beats vmap of
+    per-row ops only when XLA can't fuse, but explicit batch code also skips
+    per-item host dispatch for items-mode data). ``vmap_batch=False`` forces
+    host-side per-item mapping (non-traceable transformers).
+    """
+
+    vmap_batch: bool = True
+
+    def apply(self, x: Any) -> Any:  # single datum
+        raise NotImplementedError
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        if ds.is_array and self.vmap_batch:
+            return Dataset.from_array(
+                jax.vmap(self.apply)(ds.padded()), n=ds.n
+            )
+        return ds.map(self.apply)
+
+    # TransformerOperator ABI
+    def single_transform(self, inputs: Sequence[Any]) -> Any:
+        return self.apply(inputs[0])
+
+    def batch_transform(self, inputs: Sequence[Dataset]) -> Dataset:
+        return self.apply_batch(inputs[0])
+
+    def to_pipeline(self) -> Pipeline:
+        g, src = EMPTY_GRAPH.add_source()
+        g, nid = g.add_node(self, (src,))
+        g, sink = g.add_sink(nid)
+        return Pipeline(GraphExecutor(g), src, sink)
+
+    def __call__(self, data: Any) -> Any:
+        return self.to_pipeline().apply(data)
+
+    def eq_key(self) -> Any:
+        if dataclasses.is_dataclass(self):
+            return (
+                type(self),
+                tuple(
+                    (f.name, _hashable(getattr(self, f.name)))
+                    for f in dataclasses.fields(self)
+                ),
+            )
+        return id(self)
+
+    @property
+    def label(self) -> str:  # type: ignore[override]
+        return type(self).__name__
+
+
+def transformer(fn: Callable[[Any], Any], name: str = None) -> Transformer:
+    """Factory: lift a plain function into a Transformer
+    (reference: Transformer.apply(f))."""
+
+    class _FnTransformer(Transformer):
+        def apply(self, x):
+            return fn(x)
+
+        def eq_key(self):
+            return ("fn", fn)
+
+    t = _FnTransformer()
+    t.__class__.__name__ = name or getattr(fn, "__name__", "fn")
+    return t
+
+
+class Estimator(Chainable, EstimatorOperator):
+    """fit(Dataset) -> Transformer; splice-able into a pipeline."""
+
+    def fit(self, data: Dataset) -> Transformer:
+        raise NotImplementedError
+
+    def fit_datasets(self, datasets: Sequence[Dataset]) -> TransformerOperator:
+        return self.fit(datasets[0])
+
+    def with_data(self, data: Any) -> Pipeline:
+        g, data_end = _splice_data(EMPTY_GRAPH, data)
+        g, est_node = g.add_node(self, (data_end,))
+        g, src = g.add_source()
+        g, delegate = g.add_node(DelegatingOperator(), (est_node, src))
+        g, sink = g.add_sink(delegate)
+        return Pipeline(GraphExecutor(g), src, sink)
+
+    def to_pipeline(self) -> Pipeline:
+        raise TypeError(
+            "an Estimator is not directly chainable; use and_then(est, data)"
+        )
+
+    def eq_key(self) -> Any:
+        if dataclasses.is_dataclass(self):
+            return (
+                type(self),
+                tuple(
+                    (f.name, _hashable(getattr(self, f.name)))
+                    for f in dataclasses.fields(self)
+                ),
+            )
+        return id(self)
+
+    @property
+    def label(self) -> str:  # type: ignore[override]
+        return type(self).__name__
+
+
+class LabelEstimator(Estimator):
+    """fit(Dataset, labels: Dataset) -> Transformer."""
+
+    def fit(self, data: Dataset, labels: Dataset) -> Transformer:  # type: ignore[override]
+        raise NotImplementedError
+
+    def fit_datasets(self, datasets: Sequence[Dataset]) -> TransformerOperator:
+        return self.fit(datasets[0], datasets[1])
+
+    def with_data(self, data: Any, labels: Any = None) -> Pipeline:
+        if labels is None:
+            raise TypeError("LabelEstimator.with_data needs labels")
+        g, data_end = _splice_data(EMPTY_GRAPH, data)
+        g, labels_end = _splice_data(g, labels)
+        g, est_node = g.add_node(self, (data_end, labels_end))
+        g, src = g.add_source()
+        g, delegate = g.add_node(DelegatingOperator(), (est_node, src))
+        g, sink = g.add_sink(delegate)
+        return Pipeline(GraphExecutor(g), src, sink)
+
+
+def _splice_data(g: Graph, data: Any):
+    """Attach a data producer to ``g``: a constant dataset node, or the whole
+    upstream graph of a PipelineDataset (so shared prefixes stay shared)."""
+    if isinstance(data, PipelineResult):
+        if data._graph.sources:
+            raise ValueError("cannot splice a pipeline with dangling sources")
+        g2, _, kmap = g.add_graph(data._graph)
+        end = g2.sink_dependencies[kmap[data._sink]]
+        g2 = g2.remove_sink(kmap[data._sink])
+        return g2, end
+    ds = Dataset.of(data)
+    return g.add_node(DatasetOperator(ds), ())
+
+
+class FunctionNode:
+    """Eagerly-applied pipeline-construction-time function (reference:
+    pipelines/FunctionNode.scala) — not a DAG node."""
+
+    def __call__(self, data: Any) -> Any:
+        return self.apply(data)
+
+    def apply(self, data: Any) -> Any:
+        raise NotImplementedError
+
+
+class GatherTransformerOperator(TransformerOperator):
+    """Zips N branch outputs into a per-example tuple."""
+
+    label = "gather"
+
+    def single_transform(self, inputs: Sequence[Any]) -> Any:
+        return tuple(inputs)
+
+    def batch_transform(self, inputs: Sequence[Dataset]) -> Dataset:
+        n = inputs[0].n
+        if any(ds.n != n for ds in inputs):
+            raise ValueError("gather branches disagree on dataset length")
+        if all(ds.is_array for ds in inputs):
+            pn = max(ds.padded_n for ds in inputs)
+            arrs = tuple(ds._pad_to(pn).padded() for ds in inputs)
+            return Dataset.from_array(arrs, n=n)
+        cols = [ds.items() for ds in inputs]
+        return Dataset.from_items([tuple(row) for row in zip(*cols)])
+
+    def eq_key(self) -> Any:
+        return ("gather",)
+
+
+class Identity(Transformer):
+    def apply(self, x):
+        return x
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        return ds
+
+    def eq_key(self):
+        return ("identity",)
+
+
+class FittedPipeline:
+    """A train-free, serializable transformer-only pipeline.
+
+    ``apply`` interprets the graph node-by-node (cheap — the work is inside
+    batched XLA ops); ``jit()`` stages the whole single-example path into one
+    compiled XLA program for steady-state serving.
+    """
+
+    def __init__(self, graph: Graph, source: SourceId, sink: SinkId):
+        self.graph = graph
+        self.source = source
+        self.sink = sink
+        self._topo = [
+            gid for gid in linearize(graph) if isinstance(gid, NodeId)
+        ]
+
+    def _run(self, feed: Any, batch: bool) -> Any:
+        values: Dict[Any, Any] = {self.source: feed}
+        for n in self._topo:
+            op = self.graph.operators[n]
+            ins = [values[d] for d in self.graph.dependencies[n]]
+            if batch:
+                values[n] = op.batch_transform(ins)
+            else:
+                values[n] = op.single_transform(ins)
+        return values[self.graph.sink_dependencies[self.sink]]
+
+    def apply(self, data: Any) -> Any:
+        if isinstance(data, PipelineResult):
+            data = data.get()
+        if isinstance(data, Dataset):
+            return self._run(data, batch=True)
+        return self._run(data, batch=False)
+
+    __call__ = apply
+
+    def jit(self) -> Callable[[Any], Any]:
+        """The single-example apply path as one jitted function."""
+        return jax.jit(lambda x: self._run(x, batch=False))
+
+    def and_then(self, nxt: "FittedPipeline") -> "FittedPipeline":
+        g, _, sink_map = self.graph.connect_graph(
+            nxt.graph, {nxt.source: self.sink}
+        )
+        return FittedPipeline(g, self.source, sink_map[nxt.sink])
+
+    # -- persistence (reference: FittedPipeline is Serializable) ----------
+
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump(self, f)
+
+    @staticmethod
+    def load(path: str) -> "FittedPipeline":
+        with open(path, "rb") as f:
+            return pickle.load(f)

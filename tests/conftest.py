@@ -1,0 +1,42 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The reference tests run Spark in local[n] mode with multi-partition RDDs
+standing in for a cluster (SURVEY.md §4); the equivalent here is
+--xla_force_host_platform_device_count=8 so sharding/collective code paths are
+exercised without TPU hardware. Must be set before jax initializes.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def reset_pipeline_env():
+    """Each test gets a fresh global pipeline environment (reference:
+    PipelineContext.afterEach calls PipelineEnv.reset)."""
+    from keystone_tpu.workflow.executor import PipelineEnv
+    from keystone_tpu.parallel import mesh as mesh_lib
+
+    PipelineEnv.get_or_create().reset()
+    mesh_lib.set_mesh(None)
+    yield
+    PipelineEnv.get_or_create().reset()
+    mesh_lib.set_mesh(None)
+
+
+@pytest.fixture
+def mesh8():
+    """An 8-way data-parallel mesh over the virtual CPU devices."""
+    from keystone_tpu.parallel import mesh as mesh_lib
+
+    m = mesh_lib.make_mesh(n_data=8)
+    with mesh_lib.use_mesh(m):
+        yield m

@@ -1,0 +1,132 @@
+"""Graph IR surgery semantics (modeled on the reference GraphSuite)."""
+
+import pytest
+
+from keystone_tpu.workflow.graph import (
+    EMPTY_GRAPH,
+    NodeId,
+    SinkId,
+    SourceId,
+    get_ancestors,
+    get_children,
+    get_descendants,
+    get_parents,
+    linearize,
+)
+from keystone_tpu.workflow.operators import DatumOperator
+
+
+def op(name):
+    return DatumOperator(name, label=name)
+
+
+def chain3():
+    """source -> a -> b -> c -> sink"""
+    g, src = EMPTY_GRAPH.add_source()
+    g, a = g.add_node(op("a"), (src,))
+    g, b = g.add_node(op("b"), (a,))
+    g, c = g.add_node(op("c"), (b,))
+    g, snk = g.add_sink(c)
+    return g, src, a, b, c, snk
+
+
+def test_add_node_and_ids():
+    g, src = EMPTY_GRAPH.add_source()
+    assert src == SourceId(0)
+    g, a = g.add_node(op("a"), (src,))
+    g, b = g.add_node(op("b"), (a,))
+    assert (a, b) == (NodeId(0), NodeId(1))
+    g, snk = g.add_sink(b)
+    assert snk == SinkId(0)
+    assert g.nodes == {a, b}
+    assert g.get_dependencies(b) == (a,)
+
+
+def test_immutability():
+    g, src = EMPTY_GRAPH.add_source()
+    g2, a = g.add_node(op("a"), (src,))
+    assert a not in g.nodes
+    assert a in g2.nodes
+
+
+def test_remove_referenced_node_fails():
+    g, src, a, b, c, snk = chain3()
+    with pytest.raises(ValueError):
+        g.remove_node(a)  # b depends on it
+    with pytest.raises(ValueError):
+        g.remove_node(c)  # sink depends on it
+    with pytest.raises(ValueError):
+        g.remove_source(src)
+
+
+def test_replace_dependency():
+    g, src, a, b, c, snk = chain3()
+    g2 = g.replace_dependency(b, a)  # c now reads a directly
+    assert g2.get_dependencies(c) == (a,)
+    g3 = g2.remove_node(b)
+    assert b not in g3.nodes
+
+
+def test_add_graph_disjoint_union():
+    g1, src1, a1, b1, c1, snk1 = chain3()
+    g2, src2, a2, b2, c2, snk2 = chain3()
+    merged, smap, kmap = g1.add_graph(g2)
+    assert len(merged.nodes) == 6
+    assert len(merged.sources) == 2
+    assert len(merged.sinks) == 2
+    # remapped ids are fresh
+    assert smap[src2] != src1
+    new_c = merged.get_sink_dependency(kmap[snk2])
+    assert merged.get_operator(new_c).datum == "c"
+
+
+def test_connect_graph_splices():
+    g1, src1, a1, b1, c1, snk1 = chain3()
+    g2, src2, a2, b2, c2, snk2 = chain3()
+    merged, smap, kmap = g1.connect_graph(g2, {src2: snk1})
+    # g2's source and g1's sink are gone; g2's 'a' now reads g1's 'c'
+    assert len(merged.sources) == 1
+    assert len(merged.sinks) == 1
+    assert src2 not in smap
+    new_a2 = None
+    for n, deps in merged.dependencies.items():
+        if merged.operators[n].datum == "a" and deps and deps[0] == c1:
+            new_a2 = n
+    assert new_a2 is not None
+
+
+def test_analyses():
+    g, src, a, b, c, snk = chain3()
+    assert get_parents(g, c) == {b}
+    assert get_ancestors(g, c) == {src, a, b}
+    assert get_children(g, a) == {b}
+    assert get_descendants(g, src) == {a, b, c, snk}
+    order = linearize(g)
+    assert order.index(src) < order.index(a) < order.index(b) < order.index(c)
+
+
+def test_to_dot():
+    g, *_ = chain3()
+    dot = g.to_dot()
+    assert dot.startswith("digraph")
+    assert '"node0"' in dot and '"source0"' in dot
+
+
+def test_replace_nodes():
+    g, src, a, b, c, snk = chain3()
+    # replacement subgraph: rsrc -> x -> rsink
+    rg, rsrc = EMPTY_GRAPH.add_source()
+    rg, x = rg.add_node(op("x"), (rsrc,))
+    rg, rsnk = rg.add_sink(x)
+    g2 = g.replace_nodes(
+        nodes_to_remove={b},
+        replacement=rg,
+        replacement_source_splice={rsrc: a},
+        replacement_sink_splice={b: rsnk},
+    )
+    assert b not in g2.nodes
+    labels = {g2.operators[n].datum for n in g2.nodes}
+    assert labels == {"a", "x", "c"}
+    # c now depends on the new x node
+    (cdep,) = g2.get_dependencies(c)
+    assert g2.get_operator(cdep).datum == "x"

@@ -1,0 +1,175 @@
+"""Pipeline API semantics (modeled on the reference PipelineSuite):
+chaining, laziness, gather, the fit-once memoization guarantee, fitted
+pipeline save/load."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.workflow import (
+    Estimator,
+    LabelEstimator,
+    Pipeline,
+    PipelineEnv,
+    Transformer,
+)
+from keystone_tpu.ops.util import VectorCombiner
+
+
+@dataclasses.dataclass(eq=False)
+class Scale(Transformer):
+    factor: float
+
+    def apply(self, x):
+        return x * self.factor
+
+
+@dataclasses.dataclass(eq=False)
+class AddConst(Transformer):
+    c: float
+
+    def apply(self, x):
+        return x + self.c
+
+
+class MeanCenterEstimator(Estimator):
+    def __init__(self):
+        self.fit_count = 0
+
+    def fit(self, data: Dataset) -> Transformer:
+        self.fit_count += 1
+        mean = jnp.mean(data.array(), axis=0)
+        return AddConst(-mean)
+
+
+class OffsetLabelEstimator(LabelEstimator):
+    def __init__(self):
+        self.fit_count = 0
+
+    def fit(self, data: Dataset, labels: Dataset) -> Transformer:
+        self.fit_count += 1
+        delta = jnp.mean(labels.array() - data.array())
+        return AddConst(delta)
+
+
+def test_transformer_single_and_batch():
+    t = Scale(2.0)
+    out = t.to_pipeline().apply_datum(jnp.asarray([1.0, 2.0])).get()
+    np.testing.assert_allclose(out, [2.0, 4.0])
+    ds = Dataset.from_array(jnp.ones((4, 3)))
+    out = t(ds).get()
+    np.testing.assert_allclose(np.asarray(out.array()), 2 * np.ones((4, 3)))
+
+
+def test_chaining():
+    pipe = Scale(2.0).and_then(AddConst(1.0)).and_then(Scale(10.0))
+    out = pipe.apply_datum(jnp.asarray([1.0])).get()
+    np.testing.assert_allclose(out, [30.0])
+
+
+def test_estimator_chaining_and_laziness():
+    data = Dataset.from_array(jnp.asarray([[1.0], [3.0]]))  # mean 2
+    est = MeanCenterEstimator()
+    pipe = Scale(1.0).and_then(est, data)
+    assert est.fit_count == 0  # nothing executed yet
+    out = pipe.apply_datum(jnp.asarray([5.0]))
+    assert est.fit_count == 0  # still lazy
+    np.testing.assert_allclose(out.get(), [3.0])
+    assert est.fit_count == 1
+
+
+def test_fit_once_guarantee():
+    """Reference PipelineSuite 'Do not fit estimators multiple times'."""
+    data = Dataset.from_array(jnp.asarray([[1.0], [3.0]]))
+    est = MeanCenterEstimator()
+    pipe = Scale(1.0).and_then(est, data)
+    a = pipe.apply_datum(jnp.asarray([5.0]))
+    a.get()
+    # A *new* pipeline built from the same estimator + data shares the prefix
+    pipe2 = Scale(1.0).and_then(est, data)
+    b = pipe2.apply_datum(jnp.asarray([7.0]))
+    np.testing.assert_allclose(b.get(), [5.0])
+    assert est.fit_count == 1  # memoized via PipelineEnv prefix state
+
+
+def test_label_estimator():
+    data = Dataset.from_array(jnp.zeros((3, 1)))
+    labels = Dataset.from_array(jnp.ones((3, 1)))
+    est = OffsetLabelEstimator()
+    pipe = Scale(1.0).and_then(est, data, labels)
+    out = pipe.apply_datum(jnp.asarray([0.5])).get()
+    np.testing.assert_allclose(out, [1.5])
+    assert est.fit_count == 1
+
+
+def test_gather_and_combine():
+    branches = [Scale(1.0), Scale(2.0), Scale(3.0)]
+    pipe = Pipeline.gather(branches).and_then(VectorCombiner())
+    ds = Dataset.from_array(jnp.ones((2, 2)))
+    out = pipe(ds).get()
+    np.testing.assert_allclose(
+        np.asarray(out.array()),
+        [[1, 1, 2, 2, 3, 3], [1, 1, 2, 2, 3, 3]],
+    )
+    single = pipe.apply_datum(jnp.ones((2,))).get()
+    np.testing.assert_allclose(single, [1, 1, 2, 2, 3, 3])
+
+
+def test_fit_returns_frozen_pipeline(tmp_path):
+    data = Dataset.from_array(jnp.asarray([[2.0], [4.0]]))  # mean 3
+    est = MeanCenterEstimator()
+    pipe = Scale(1.0).and_then(est, data)
+    fitted = pipe.fit()
+    assert est.fit_count == 1
+    np.testing.assert_allclose(fitted.apply(jnp.asarray([4.0])), [1.0])
+    # batch apply
+    out = fitted.apply(Dataset.from_array(jnp.asarray([[3.0], [6.0]])))
+    np.testing.assert_allclose(np.asarray(out.array()), [[0.0], [3.0]])
+    # fitting again doesn't refit
+    pipe.fit()
+    assert est.fit_count == 1
+    # save/load
+    p = tmp_path / "fitted.pkl"
+    fitted.save(str(p))
+    from keystone_tpu.workflow import FittedPipeline
+
+    loaded = FittedPipeline.load(str(p))
+    np.testing.assert_allclose(loaded.apply(jnp.asarray([4.0])), [1.0])
+
+
+def test_fitted_pipeline_jit():
+    pipe = Scale(2.0).and_then(AddConst(1.0))
+    # a transformer-only pipeline is fit-able without estimators
+    fitted = pipe.fit()
+    f = fitted.jit()
+    np.testing.assert_allclose(f(jnp.asarray([1.0, 2.0])), [3.0, 5.0])
+
+
+def test_cse_merges_equal_branches():
+    """Two structurally equal dataclass transformers merge (CSE)."""
+    from keystone_tpu.workflow.executor import GraphExecutor
+
+    pipe = Pipeline.gather([Scale(2.0), Scale(2.0)])
+    ds = Dataset.from_array(jnp.ones((2, 1)))
+    result = pipe(ds)
+    result.get()
+    optimized = result._executor.graph
+    # gather + one merged Scale + data node = 3 operators
+    assert len(optimized.operators) == 3
+
+
+def test_unexecutable_source_dependent():
+    pipe = Scale(2.0).to_pipeline()
+    with pytest.raises(ValueError):
+        pipe.executor.execute(pipe.sink)
+
+
+def test_apply_pipeline_dataset_chains_lazily():
+    data = Dataset.from_array(jnp.ones((2, 2)))
+    stage1 = Scale(3.0)(data)  # PipelineDataset
+    stage2 = AddConst(1.0)(stage1)
+    out = stage2.get()
+    np.testing.assert_allclose(np.asarray(out.array()), 4 * np.ones((2, 2)))
