@@ -1,0 +1,87 @@
+"""Benchmark: BlockLS solver wall-clock on a TIMIT-shaped problem.
+
+BASELINE.md's closest published number is "TIMIT, Block solver, 1024
+features: 33,521 ms" on a 16-node r3.4xlarge cluster
+(scripts/solver-comparisons-final.csv:14). The KeystoneML paper's TIMIT
+set is ~2.25M train frames with 147 classes; we time one
+BlockLeastSquaresEstimator pass over the same (n, d, k) shape on the live
+TPU chip(s). Features are generated on device (the baseline row times the
+solver, not featurization); stored bf16, Gram math accumulates f32 —
+the TPU-native precision discipline.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ms, "unit": "ms", "vs_baseline": baseline/ours}
+vs_baseline > 1 means faster than the reference cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_MS = 33_521.0  # scripts/solver-comparisons-final.csv:14
+N = 2_251_569  # TIMIT train frames (KeystoneML paper scale)
+D = 1024
+K = 147
+BLOCK = 1024
+
+
+def main() -> None:
+    from keystone_tpu.ops.learning import BlockLeastSquaresEstimator
+    from keystone_tpu.parallel import mesh as mesh_lib
+    from keystone_tpu.parallel.dataset import Dataset
+
+    mesh = mesh_lib.make_mesh()
+    with mesh_lib.use_mesh(mesh):
+        nshards = mesh_lib.n_data_shards(mesh)
+        n = -(-N // nshards) * nshards
+
+        @jax.jit
+        def gen(key):
+            kx, kw = jax.random.split(key)
+            mask = (jnp.arange(n) < N).astype(jnp.float32)[:, None]
+            X = jax.random.normal(kx, (n, D), jnp.bfloat16) * mask.astype(
+                jnp.bfloat16
+            )
+            W = jax.random.normal(kw, (D, K), jnp.bfloat16) * 0.1
+            Y = jax.lax.dot_general(
+                X, W, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) + 0.01 * mask * jax.random.normal(
+                jax.random.fold_in(kw, 1), (n, K), jnp.float32
+            )
+            return X, Y
+
+        X, Y = gen(jax.random.PRNGKey(0))
+        X = jax.device_put(X, mesh_lib.data_sharding(mesh))
+        Y = jax.device_put(Y, mesh_lib.data_sharding(mesh))
+        jax.block_until_ready((X, Y))
+        Xd = Dataset.from_array(X, n=N)
+        Yd = Dataset.from_array(Y, n=N)
+
+        est = BlockLeastSquaresEstimator(block_size=BLOCK, num_iter=1, lam=0.1)
+        # warm-up compile on the same shapes
+        est.fit(Xd, Yd)
+        t0 = time.perf_counter()
+        model = est.fit(Xd, Yd)
+        jax.block_until_ready(model.W)
+        elapsed_ms = (time.perf_counter() - t0) * 1000.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "timit_block_ls_1024_solve",
+                "value": round(elapsed_ms, 1),
+                "unit": "ms",
+                "vs_baseline": round(BASELINE_MS / elapsed_ms, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+from keystone_tpu.evaluation.multiclass import (
+    MulticlassClassifierEvaluator,
+    MulticlassMetrics,
+)
+from keystone_tpu.evaluation.binary import (
+    BinaryClassifierEvaluator,
+    BinaryClassificationMetrics,
+)
+from keystone_tpu.evaluation.mean_average_precision import (
+    MeanAveragePrecisionEvaluator,
+)
+from keystone_tpu.evaluation.augmented import AugmentedExamplesEvaluator
+
+__all__ = [
+    "AugmentedExamplesEvaluator",
+    "BinaryClassificationMetrics",
+    "BinaryClassifierEvaluator",
+    "MeanAveragePrecisionEvaluator",
+    "MulticlassClassifierEvaluator",
+    "MulticlassMetrics",
+]

@@ -1,0 +1,3 @@
+from keystone_tpu.loaders.csv_loader import CsvDataLoader, LabeledData
+
+__all__ = ["CsvDataLoader", "LabeledData"]

@@ -1,0 +1,53 @@
+"""CSV loading + labeled-data pair holder.
+
+Reference: loaders/CsvDataLoader.scala:10 (textFile -> split -> DenseVector)
+and loaders/LabeledData.scala:12 (labeled-RDD pair holder). Host-side IO
+feeding a sharded device array — the input-pipeline stand-in for RDD reads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.parallel.dataset import Dataset
+
+
+def CsvDataLoader(path: str, delimiter: str = ",") -> Dataset:
+    """Load a numeric CSV into one array-mode Dataset (n, d)."""
+    arr = np.loadtxt(path, delimiter=delimiter, dtype=np.float32, ndmin=2)
+    return Dataset.from_array(jnp.asarray(arr))
+
+
+@dataclasses.dataclass
+class LabeledData:
+    """Holds (labels, data) with convenience accessors (reference:
+    loaders/LabeledData.scala)."""
+
+    labels: Dataset
+    data: Dataset
+
+    @staticmethod
+    def from_csv(
+        path: str,
+        label_col: int = 0,
+        label_offset: int = 0,
+        delimiter: str = ",",
+    ) -> "LabeledData":
+        """First (or ``label_col``-th) column is the integer label;
+        ``label_offset`` is subtracted (MNIST CSVs are 1-indexed in the
+        reference app, MnistRandomFFT.scala:31-38)."""
+        arr = np.loadtxt(path, delimiter=delimiter, dtype=np.float32, ndmin=2)
+        labels = arr[:, label_col].astype(np.int32) - label_offset
+        data = np.delete(arr, label_col, axis=1)
+        return LabeledData(
+            labels=Dataset.from_array(jnp.asarray(labels)),
+            data=Dataset.from_array(jnp.asarray(data)),
+        )
+
+    @staticmethod
+    def of(labels, data) -> "LabeledData":
+        return LabeledData(labels=Dataset.of(labels), data=Dataset.of(data))

@@ -1,0 +1,19 @@
+from keystone_tpu.ops.learning.linear import (
+    LinearMapEstimator,
+    LinearMapper,
+    LocalLeastSquaresEstimator,
+    SparseLinearMapper,
+)
+from keystone_tpu.ops.learning.block_ls import (
+    BlockLeastSquaresEstimator,
+    BlockLinearMapper,
+)
+
+__all__ = [
+    "BlockLeastSquaresEstimator",
+    "BlockLinearMapper",
+    "LinearMapEstimator",
+    "LinearMapper",
+    "LocalLeastSquaresEstimator",
+    "SparseLinearMapper",
+]

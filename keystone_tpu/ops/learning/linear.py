@@ -1,0 +1,138 @@
+"""Dense/sparse linear maps and exact least-squares solvers.
+
+Reference: nodes/learning/LinearMapper.scala (LinearMapper/LinearMapEstimator
+— mlmatrix NormalEquations), LocalLeastSquaresEstimator.scala (dual-form OLS
+for d >> n), SparseLinearMapper.scala.
+
+TPU-first: the normal-equation Gram matrices are contractions over the
+sharded example axis of one device-resident matrix — under jit XLA lowers
+them to per-shard MXU matmuls plus a psum over the mesh's data axis, which
+is exactly the reference's executor-GEMM + treeReduce pattern with the
+driver roundtrip removed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from keystone_tpu.ops.learning.hostsolve import psd_solve_host
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.workflow.api import LabelEstimator, Transformer
+
+
+@jax.jit
+def _grams(A, b):
+    return A.T @ A, A.T @ b
+
+
+@dataclasses.dataclass(eq=False)
+class LinearMapper(Transformer):
+    """x -> x @ W (+ intercept), optionally standard-scaling the input first
+    (reference: nodes/learning/LinearMapper.scala:18)."""
+
+    W: Any  # (d, k)
+    intercept: Optional[Any] = None  # (k,)
+    feature_scaler: Optional[Any] = None  # StandardScalerModel or None
+
+    def apply(self, x):
+        if self.feature_scaler is not None:
+            x = self.feature_scaler.apply(x)
+        out = x @ self.W
+        if self.intercept is not None:
+            out = out + self.intercept
+        return out
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        if self.feature_scaler is not None:
+            ds = self.feature_scaler.apply_batch(ds)
+        out = ds.padded() @ self.W
+        if self.intercept is not None:
+            out = (out + self.intercept) * ds.mask()[:, None]
+        return Dataset.from_array(out, n=ds.n)
+
+
+@dataclasses.dataclass(eq=False)
+class LinearMapEstimator(LabelEstimator):
+    """Exact OLS via normal equations with optional L2
+    (reference: nodes/learning/LinearMapper.scala:69-116 — mlmatrix
+    NormalEquations: solve (AᵀA + λI) W = Aᵀb)."""
+
+    lam: float = 0.0
+
+    def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
+        A = data.padded()
+        b = labels.padded()
+        gram, rhs = _grams(A, b)
+        # f64 host solve of the (d,d) system (reference: driver-side
+        # NormalEquations; see hostsolve.py for the precision rationale).
+        W = jnp.asarray(psd_solve_host(gram, rhs, self.lam), A.dtype)
+        return LinearMapper(W)
+
+    @staticmethod
+    def compute_cost(
+        data: Dataset, labels: Dataset, lam: float, W, intercept=None
+    ) -> float:
+        """0.5·‖AW − b‖² + 0.5·λ‖W‖² (reference: LinearMapper.computeCost)."""
+        A = data.padded()
+        b = labels.padded()
+        pred = A @ W
+        if intercept is not None:
+            pred = (pred + intercept) * data.mask()[:, None]
+        res = jnp.sum((pred - b) ** 2)
+        return float(0.5 * res + 0.5 * lam * jnp.sum(W * W))
+
+
+@dataclasses.dataclass(eq=False)
+class LocalLeastSquaresEstimator(LabelEstimator):
+    """Dual-form OLS for d >> n: W = Aᵀ (A Aᵀ + λ n I)⁻¹ b
+    (reference: nodes/learning/LocalLeastSquaresEstimator.scala:35 — driver
+    local; here one small-n device solve)."""
+
+    lam: float = 0.0
+
+    def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
+        A = data.array()
+        b = labels.array()
+        n = A.shape[0]
+        K = jax.jit(lambda A: A @ A.T)(A)
+        alpha = psd_solve_host(K, np.asarray(b), self.lam * n)
+        W = jnp.asarray(np.asarray(A).T @ alpha, A.dtype)
+        return LinearMapper(W)
+
+
+@dataclasses.dataclass(eq=False)
+class SparseLinearMapper(Transformer):
+    """Sparse-input linear map (reference:
+    nodes/learning/SparseLinearMapper.scala:13). Inputs are BCOO vectors or
+    a batched BCOO matrix; the model stays dense and replicated."""
+
+    W: Any  # (d, k)
+    intercept: Optional[Any] = None
+    vmap_batch = False
+
+    def apply(self, x):
+        if isinstance(x, jsparse.BCOO):
+            out = x @ self.W
+        else:
+            out = jnp.asarray(x) @ self.W
+        if self.intercept is not None:
+            out = out + self.intercept
+        return out
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        x = ds.padded()
+        if isinstance(x, jsparse.BCOO):
+            out = jsparse.bcoo_dot_general(
+                x, self.W, dimension_numbers=(([1], [0]), ([], []))
+            )
+        else:
+            out = x @ self.W
+        if self.intercept is not None:
+            out = out + self.intercept
+        return Dataset.from_array(out, n=ds.n)

@@ -1,0 +1,27 @@
+from keystone_tpu.ops.stats.nodes import (
+    ColumnSampler,
+    CosineRandomFeatures,
+    LinearRectifier,
+    NormalizeRows,
+    PaddedFFT,
+    RandomSignNode,
+    Sampler,
+    SignedHellingerMapper,
+    StandardScaler,
+    StandardScalerModel,
+    TermFrequency,
+)
+
+__all__ = [
+    "ColumnSampler",
+    "CosineRandomFeatures",
+    "LinearRectifier",
+    "NormalizeRows",
+    "PaddedFFT",
+    "RandomSignNode",
+    "Sampler",
+    "SignedHellingerMapper",
+    "StandardScaler",
+    "StandardScalerModel",
+    "TermFrequency",
+]

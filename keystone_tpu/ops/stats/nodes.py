@@ -1,0 +1,271 @@
+"""Statistical feature nodes.
+
+Reference: nodes/stats/*.scala — CosineRandomFeatures, PaddedFFT,
+StandardScaler, LinearRectifier, RandomSignNode, NormalizeRows,
+SignedHellingerMapper, TermFrequency, Sampling.
+
+TPU-first notes: every batch path is one fused jnp expression over the
+sharded (n, d) matrix — XLA maps the matmuls onto the MXU and fuses the
+elementwise tails; reductions over the example axis turn into psums over the
+mesh's data axis automatically under jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.workflow.api import Estimator, FunctionNode, Transformer
+
+
+@dataclasses.dataclass(eq=False)
+class RandomSignNode(Transformer):
+    """Elementwise multiply by a fixed ±1 sign vector (reference:
+    nodes/stats/RandomSignNode.scala:10; factory draws Binomial signs)."""
+
+    signs: Any  # (d,) array of ±1
+
+    @staticmethod
+    def create(d: int, seed: int = 0) -> "RandomSignNode":
+        rng = np.random.default_rng(seed)
+        signs = rng.integers(0, 2, size=d).astype(np.float32) * 2.0 - 1.0
+        return RandomSignNode(jnp.asarray(signs))
+
+    def apply(self, x):
+        return x * self.signs
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        return Dataset.from_array(ds.padded() * self.signs, n=ds.n)
+
+
+@dataclasses.dataclass(eq=False)
+class PaddedFFT(Transformer):
+    """Zero-pad to the next power of two, real FFT, keep the real parts of
+    the first half (reference: nodes/stats/PaddedFFT.scala:13 — Breeze
+    fourierTr then x(0 until pad/2).map(_.real))."""
+
+    def _pad_len(self, d: int) -> int:
+        return int(2 ** np.ceil(np.log2(max(d, 1))))
+
+    def apply(self, x):
+        pad = self._pad_len(x.shape[-1])
+        xp = jnp.zeros(pad, x.dtype).at[: x.shape[-1]].set(x)
+        return jnp.real(jnp.fft.fft(xp))[: pad // 2]
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        x = ds.padded()
+        pad = self._pad_len(x.shape[-1])
+        xp = jnp.pad(x, ((0, 0), (0, pad - x.shape[-1])))
+        return Dataset.from_array(
+            jnp.real(jnp.fft.fft(xp, axis=-1))[:, : pad // 2], n=ds.n
+        )
+
+    def eq_key(self):
+        return ("padded_fft",)
+
+
+@dataclasses.dataclass(eq=False)
+class LinearRectifier(Transformer):
+    """max(max_val, x - alpha) (reference:
+    nodes/stats/LinearRectifier.scala:12)."""
+
+    max_val: float = 0.0
+    alpha: float = 0.0
+
+    def apply(self, x):
+        return jnp.maximum(self.max_val, x - self.alpha)
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        out = jnp.maximum(self.max_val, ds.padded() - self.alpha)
+        if self.max_val > 0 or self.alpha < 0:
+            # rectified zero pad rows would be nonzero: keep the invariant
+            out = out * ds.mask()[:, None]
+        return Dataset.from_array(out, n=ds.n)
+
+
+@dataclasses.dataclass(eq=False)
+class NormalizeRows(Transformer):
+    """L2 row normalization with a tiny-norm floor (reference:
+    nodes/stats/NormalizeRows.scala:10, floor 2.2e-16)."""
+
+    floor: float = 2.2e-16
+
+    def apply(self, x):
+        nrm = jnp.linalg.norm(x)
+        return x / jnp.maximum(nrm, self.floor)
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        x = ds.padded()
+        nrm = jnp.linalg.norm(x, axis=-1, keepdims=True)
+        return Dataset.from_array(x / jnp.maximum(nrm, self.floor), n=ds.n)
+
+
+@dataclasses.dataclass(eq=False)
+class SignedHellingerMapper(Transformer):
+    """Signed square-root power normalization: sign(x) * sqrt(|x|)
+    (reference: nodes/stats/SignedHellingerMapper.scala:12; the Batch- matrix
+    variant is the same expression on a matrix)."""
+
+    def apply(self, x):
+        return jnp.sign(x) * jnp.sqrt(jnp.abs(x))
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        x = ds.padded()
+        return Dataset.from_array(jnp.sign(x) * jnp.sqrt(jnp.abs(x)), n=ds.n)
+
+    def eq_key(self):
+        return ("signed_hellinger",)
+
+
+@dataclasses.dataclass(eq=False)
+class StandardScalerModel(Transformer):
+    """x -> (x - mean) / std (std division optional). Padding rows are
+    re-zeroed after centering so downstream Gram-matrix math stays exact
+    (reference: nodes/stats/StandardScaler.scala:16)."""
+
+    mean: Any  # (d,)
+    std: Optional[Any] = None  # (d,) or None
+
+    def apply(self, x):
+        out = x - self.mean
+        if self.std is not None:
+            out = out / self.std
+        return out
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        x = ds.padded()
+        out = x - self.mean
+        if self.std is not None:
+            out = out / self.std
+        out = out * ds.mask()[:, None]
+        return Dataset.from_array(out, n=ds.n)
+
+
+@dataclasses.dataclass(eq=False)
+class StandardScaler(Estimator):
+    """Column mean/std via one sharded reduction pass (reference:
+    nodes/stats/StandardScaler.scala:38 — treeAggregate of a
+    MultivariateOnlineSummarizer; here the all-reduce is the XLA psum that
+    jit inserts for the sum over the sharded example axis). Unbiased
+    variance (n-1), eps guard matching MLlib behavior."""
+
+    normalize_std_dev: bool = True
+    eps: float = 1e-12
+
+    def fit(self, data: Dataset) -> StandardScalerModel:
+        x = data.padded()
+        n = data.n
+        s1 = jnp.sum(x, axis=0)  # pad rows are zero — exact
+        s2 = jnp.sum(x * x, axis=0)
+        mean = s1 / n
+        if not self.normalize_std_dev:
+            return StandardScalerModel(mean, None)
+        var = (s2 - n * mean * mean) / max(n - 1, 1)
+        std = jnp.sqrt(jnp.maximum(var, 0.0))
+        std = jnp.where(std < self.eps, 1.0, std)
+        return StandardScalerModel(mean, std)
+
+
+@dataclasses.dataclass(eq=False)
+class CosineRandomFeatures(Transformer):
+    """Random Fourier features cos(x Wᵀ + b) (reference:
+    nodes/stats/CosineRandomFeatures.scala:19,49 — batch path is one GEMM
+    with broadcast W; here one MXU matmul + fused cos)."""
+
+    W: Any  # (num_features, d)
+    b: Any  # (num_features,)
+
+    @staticmethod
+    def create(
+        d: int,
+        num_features: int,
+        gamma: float,
+        seed: int = 0,
+        distribution: str = "gaussian",
+    ) -> "CosineRandomFeatures":
+        rng = np.random.default_rng(seed)
+        if distribution == "cauchy":
+            w = rng.standard_cauchy((num_features, d)) * gamma
+        else:
+            w = rng.standard_normal((num_features, d)) * gamma
+        b = rng.uniform(0.0, 2.0 * np.pi, num_features)
+        return CosineRandomFeatures(
+            jnp.asarray(w, jnp.float32), jnp.asarray(b, jnp.float32)
+        )
+
+    def apply(self, x):
+        return jnp.cos(x @ self.W.T + self.b)
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        x = ds.padded()
+        out = jnp.cos(x @ self.W.T + self.b)
+        # cos(0 + b) != 0: keep the pad-rows-are-zero invariant
+        out = out * ds.mask()[:, None]
+        return Dataset.from_array(out, n=ds.n)
+
+
+@dataclasses.dataclass(eq=False)
+class TermFrequency(Transformer):
+    """term sequence -> {term: weighted count} with a pluggable weighting
+    function (reference: nodes/stats/TermFrequency.scala:19)."""
+
+    fn: Callable[[float], float] = lambda x: x
+    vmap_batch = False
+
+    def apply(self, terms):
+        counts: dict = {}
+        for t in terms:
+            counts[t] = counts.get(t, 0) + 1
+        return {k: self.fn(v) for k, v in counts.items()}
+
+    def eq_key(self):
+        return ("term_frequency", self.fn)
+
+
+class ColumnSampler(Transformer):
+    """Sample ``num_cols`` columns of each (d, m) matrix datum — used to
+    subsample per-image descriptor sets before PCA/GMM fits (reference:
+    nodes/stats/Sampling.scala:12)."""
+
+    vmap_batch = False
+
+    def __init__(self, num_cols: int, seed: int = 0):
+        self.num_cols = num_cols
+        self.seed = seed
+        self._counter = 0
+
+    def apply(self, m):
+        arr = np.asarray(m)
+        # independent draw per datum (reference samples per image)
+        rng = np.random.default_rng((self.seed, self._counter))
+        self._counter += 1
+        idx = rng.integers(0, arr.shape[1], self.num_cols)
+        return jnp.asarray(arr[:, idx])
+
+    def eq_key(self):
+        return ("column_sampler", self.num_cols, self.seed)
+
+
+class Sampler(FunctionNode):
+    """Eager takeSample of ~``size`` examples (reference:
+    nodes/stats/Sampling.scala:28)."""
+
+    def __init__(self, size: int, seed: int = 0):
+        self.size = size
+        self.seed = seed
+
+    def apply(self, data: Any) -> Dataset:
+        ds = Dataset.of(data)
+        rng = np.random.default_rng(self.seed)
+        k = min(self.size, ds.n)
+        idx = np.sort(rng.choice(ds.n, size=k, replace=False))
+        if ds.is_array and not isinstance(ds.padded(), tuple):
+            x = np.asarray(ds.array())
+            return Dataset.from_array(jnp.asarray(x[idx]), n=k)
+        items = ds.items()
+        return Dataset.from_items([items[i] for i in idx])

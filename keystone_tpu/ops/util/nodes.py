@@ -57,9 +57,10 @@ class ClassLabelIndicators(Transformer):
 
     def apply_batch(self, ds: Dataset) -> Dataset:
         y = ds.padded().astype(jnp.int32)
-        return Dataset.from_array(
-            2.0 * jax.nn.one_hot(y, self.num_classes) - 1.0, n=ds.n
-        )
+        out = 2.0 * jax.nn.one_hot(y, self.num_classes) - 1.0
+        # one-hot of zero pad rows is (+1,-1,...): keep pad rows zero
+        out = out * ds.mask()[:, None]
+        return Dataset.from_array(out, n=ds.n)
 
 
 @dataclasses.dataclass(eq=False)

@@ -1,0 +1,122 @@
+"""Solver tests (reference suites: LinearMapperSuite,
+BlockLinearMapperSuite — distributed solutions vs local closed form)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from keystone_tpu.ops.learning import (
+    BlockLeastSquaresEstimator,
+    LinearMapEstimator,
+    LocalLeastSquaresEstimator,
+)
+from keystone_tpu.parallel.dataset import Dataset
+
+
+def _ols(A, b, lam=0.0):
+    d = A.shape[1]
+    return np.linalg.solve(A.T @ A + lam * np.eye(d), A.T @ b)
+
+
+def test_linear_map_estimator_exact(mesh8):
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((64, 8)).astype(np.float32)
+    W_true = rng.standard_normal((8, 3)).astype(np.float32)
+    b = A @ W_true
+    model = LinearMapEstimator().fit(
+        Dataset.of(A).shard(), Dataset.of(b).shard()
+    )
+    np.testing.assert_allclose(np.asarray(model.W), W_true, atol=1e-3)
+    out = np.asarray(model.apply_batch(Dataset.of(A)).array())
+    np.testing.assert_allclose(out, b, atol=1e-2)
+
+
+def test_linear_map_estimator_l2(mesh8):
+    rng = np.random.default_rng(1)
+    A = rng.standard_normal((50, 6)).astype(np.float32)
+    b = rng.standard_normal((50, 2)).astype(np.float32)
+    lam = 0.7
+    model = LinearMapEstimator(lam=lam).fit(Dataset.of(A), Dataset.of(b))
+    np.testing.assert_allclose(np.asarray(model.W), _ols(A, b, lam), atol=2e-3)
+
+
+def test_local_least_squares_d_gg_n():
+    rng = np.random.default_rng(2)
+    A = rng.standard_normal((20, 100)).astype(np.float32)
+    b = rng.standard_normal((20, 4)).astype(np.float32)
+    model = LocalLeastSquaresEstimator(lam=0.1).fit(Dataset.of(A), Dataset.of(b))
+    n = 20
+    K = A @ A.T + 0.1 * n * np.eye(n)
+    expect = A.T @ np.linalg.solve(K, b)
+    np.testing.assert_allclose(np.asarray(model.W), expect, atol=2e-3)
+
+
+def test_block_ls_single_block_matches_exact(mesh8):
+    """With one block and no padding issues, one BCD sweep = exact
+    regularized OLS on centered data."""
+    rng = np.random.default_rng(3)
+    A = rng.standard_normal((64, 8)).astype(np.float32)
+    W_true = rng.standard_normal((8, 3)).astype(np.float32)
+    b = A @ W_true + 0.5
+    est = BlockLeastSquaresEstimator(block_size=8, num_iter=1, lam=0.0)
+    model = est.fit(Dataset.of(A).shard(), Dataset.of(b).shard())
+    Ac = A - A.mean(0)
+    bc = b - b.mean(0)
+    expect = _ols(Ac, bc)
+    np.testing.assert_allclose(np.asarray(model.W), expect, atol=5e-3)
+    pred = np.asarray(model.apply_batch(Dataset.of(A)).array())
+    np.testing.assert_allclose(pred, b, atol=5e-2)
+
+
+def test_block_ls_converges_to_exact_with_iters(mesh8):
+    """Multi-block BCD approaches the exact solution as sweeps increase."""
+    rng = np.random.default_rng(4)
+    A = rng.standard_normal((128, 12)).astype(np.float32)
+    W_true = rng.standard_normal((12, 2)).astype(np.float32)
+    b = A @ W_true
+    lam = 1e-3
+    Ac = A - A.mean(0)
+    bc = b - b.mean(0)
+    exact = _ols(Ac, bc, lam)
+
+    err1 = _fit_err(A, b, lam, num_iter=1, exact=exact)
+    err10 = _fit_err(A, b, lam, num_iter=10, exact=exact)
+    assert err10 < err1 or err10 < 1e-3
+    assert err10 < 1e-2
+
+
+def _fit_err(A, b, lam, num_iter, exact):
+    est = BlockLeastSquaresEstimator(block_size=5, num_iter=num_iter, lam=lam)
+    model = est.fit(Dataset.of(A), Dataset.of(b))
+    return float(np.abs(np.asarray(model.W) - exact).max())
+
+
+def test_block_ls_padding_exact(mesh8):
+    """Padded rows (n not a multiple of shard count) must not change the
+    solution."""
+    rng = np.random.default_rng(5)
+    A = rng.standard_normal((61, 6)).astype(np.float32)
+    b = rng.standard_normal((61, 2)).astype(np.float32)
+    est = BlockLeastSquaresEstimator(block_size=6, num_iter=1, lam=0.1)
+    m_sharded = est.fit(Dataset.of(A).shard(), Dataset.of(b).shard())
+    m_plain = est.fit(Dataset.of(A), Dataset.of(b))
+    np.testing.assert_allclose(
+        np.asarray(m_sharded.W), np.asarray(m_plain.W), atol=1e-4
+    )
+
+
+def test_block_linear_mapper_apply_and_evaluate(mesh8):
+    rng = np.random.default_rng(6)
+    A = rng.standard_normal((32, 10)).astype(np.float32)
+    b = rng.standard_normal((32, 3)).astype(np.float32)
+    est = BlockLeastSquaresEstimator(block_size=4, num_iter=2, lam=0.01)
+    model = est.fit(Dataset.of(A), Dataset.of(b))
+    seen = []
+    model.apply_and_evaluate(Dataset.of(A), lambda out: seen.append(out))
+    assert len(seen) == 3  # ceil(10/4) blocks
+    final = np.asarray(model.apply_batch(Dataset.of(A)).array())
+    np.testing.assert_allclose(np.asarray(seen[-1])[:32], final, atol=1e-4)
+
+
+def test_block_ls_weight():
+    assert BlockLeastSquaresEstimator(10, num_iter=3).weight == 10

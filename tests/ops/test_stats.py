@@ -1,0 +1,105 @@
+"""Stats node tests (reference suites: nodes/stats/*Suite.scala)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from keystone_tpu.ops.stats import (
+    ColumnSampler,
+    CosineRandomFeatures,
+    LinearRectifier,
+    NormalizeRows,
+    PaddedFFT,
+    RandomSignNode,
+    Sampler,
+    SignedHellingerMapper,
+    StandardScaler,
+)
+from keystone_tpu.parallel.dataset import Dataset
+
+
+def test_random_sign_node_involution():
+    node = RandomSignNode.create(16, seed=3)
+    x = np.random.default_rng(0).standard_normal((5, 16)).astype(np.float32)
+    out = np.asarray(node.apply_batch(Dataset.of(x)).array())
+    # applying signs twice recovers the input
+    again = np.asarray(node.apply_batch(Dataset.of(out)).array())
+    np.testing.assert_allclose(again, x, rtol=1e-6)
+    assert set(np.unique(np.asarray(node.signs))) <= {-1.0, 1.0}
+
+
+def test_padded_fft_matches_numpy():
+    x = np.random.default_rng(1).standard_normal((3, 10)).astype(np.float32)
+    out = np.asarray(PaddedFFT().apply_batch(Dataset.of(x)).array())
+    pad = 16
+    expect = np.real(np.fft.fft(np.pad(x, ((0, 0), (0, pad - 10)))))[:, :8]
+    np.testing.assert_allclose(out, expect, atol=1e-4)
+    assert out.shape == (3, 8)
+
+
+def test_linear_rectifier():
+    x = np.array([[-1.0, 0.5, 2.0]], np.float32)
+    out = np.asarray(
+        LinearRectifier(0.0, 0.25).apply_batch(Dataset.of(x)).array()
+    )
+    np.testing.assert_allclose(out, [[0.0, 0.25, 1.75]])
+
+
+def test_normalize_rows():
+    x = np.random.default_rng(2).standard_normal((4, 7)).astype(np.float32)
+    out = np.asarray(NormalizeRows().apply_batch(Dataset.of(x)).array())
+    np.testing.assert_allclose(
+        np.linalg.norm(out, axis=1), np.ones(4), rtol=1e-5
+    )
+
+
+def test_signed_hellinger():
+    x = np.array([[-4.0, 9.0, 0.0]], np.float32)
+    out = np.asarray(
+        SignedHellingerMapper().apply_batch(Dataset.of(x)).array()
+    )
+    np.testing.assert_allclose(out, [[-2.0, 3.0, 0.0]])
+
+
+def test_standard_scaler_stats(mesh8):
+    rng = np.random.default_rng(3)
+    x = (rng.standard_normal((100, 5)) * 3 + 7).astype(np.float32)
+    ds = Dataset.of(x).shard()
+    model = StandardScaler().fit(ds)
+    np.testing.assert_allclose(np.asarray(model.mean), x.mean(0), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(model.std), x.std(0, ddof=1), rtol=1e-3
+    )
+    out = np.asarray(model.apply_batch(ds).array())
+    np.testing.assert_allclose(out.mean(0), np.zeros(5), atol=1e-4)
+    np.testing.assert_allclose(out.std(0, ddof=1), np.ones(5), rtol=1e-3)
+
+
+def test_standard_scaler_respects_padding(mesh8):
+    # 10 valid rows sharded 8 ways -> padded to 16; stats must use n=10
+    x = np.ones((10, 3), np.float32) * 5
+    ds = Dataset.of(x).shard()
+    assert ds.padded_n == 16
+    model = StandardScaler(normalize_std_dev=False).fit(ds)
+    np.testing.assert_allclose(np.asarray(model.mean), [5, 5, 5], rtol=1e-6)
+    out = model.apply_batch(ds)
+    # padding rows stay zero after centering
+    assert np.allclose(np.asarray(out.padded())[10:], 0.0)
+
+
+def test_cosine_random_features_shape_and_range():
+    node = CosineRandomFeatures.create(d=6, num_features=32, gamma=0.5, seed=0)
+    x = np.random.default_rng(4).standard_normal((9, 6)).astype(np.float32)
+    out = np.asarray(node.apply_batch(Dataset.of(x)).array())
+    assert out.shape == (9, 32)
+    assert np.all(out <= 1.0) and np.all(out >= -1.0)
+    single = np.asarray(node.apply(jnp.asarray(x[0])))
+    np.testing.assert_allclose(out[0], single, atol=1e-5)
+
+
+def test_column_sampler_and_sampler():
+    mats = [np.random.default_rng(i).standard_normal((4, 20)) for i in range(3)]
+    out = ColumnSampler(5, seed=0).apply_batch(Dataset.from_items(mats))
+    assert all(np.asarray(m).shape == (4, 5) for m in out.items())
+    ds = Sampler(10, seed=0).apply(np.arange(100.0).reshape(50, 2))
+    assert ds.n == 10

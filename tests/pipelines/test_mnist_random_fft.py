@@ -1,0 +1,30 @@
+"""End-to-end MnistRandomFFT on the virtual 8-device mesh (reference:
+pipelines/images/mnist/MnistRandomFFT.scala)."""
+
+import numpy as np
+
+from keystone_tpu.pipelines.images.mnist_random_fft import (
+    MnistRandomFFTConfig,
+    run,
+    synthetic_mnist,
+)
+
+
+def test_mnist_random_fft_end_to_end(mesh8):
+    # n=256 < D=1024 is the interpolation regime: lam must be large enough
+    # to regularize (the reference app runs n=60000 >> D)
+    train, test = synthetic_mnist(n_train=256, n_test=64, seed=0)
+    conf = MnistRandomFFTConfig(num_ffts=2, block_size=512, lam=10.0)
+    pipeline, metrics = run(train, test, conf)
+    # well-separated synthetic blobs: near-perfect accuracy
+    assert metrics.total_accuracy > 0.9
+
+
+def test_mnist_fitted_pipeline_serves(mesh8):
+    train, test = synthetic_mnist(n_train=256, n_test=8, seed=1)
+    conf = MnistRandomFFTConfig(num_ffts=2, block_size=512, lam=10.0)
+    pipeline, _ = run(train, test, conf)
+    fitted = pipeline.fit()
+    batch = np.asarray(fitted.apply(test.data).array())
+    one = fitted.jit()(test.data.array()[0])
+    assert int(one) == int(batch[0])

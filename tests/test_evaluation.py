@@ -1,0 +1,47 @@
+"""Evaluator tests (reference: evaluation/*Suite.scala)."""
+
+import numpy as np
+
+from keystone_tpu.evaluation import (
+    AugmentedExamplesEvaluator,
+    BinaryClassifierEvaluator,
+    MeanAveragePrecisionEvaluator,
+    MulticlassClassifierEvaluator,
+)
+
+
+def test_multiclass_confusion_and_accuracy():
+    pred = np.array([0, 1, 2, 1, 0, 2])
+    lab = np.array([0, 1, 1, 1, 0, 2])
+    m = MulticlassClassifierEvaluator(3).evaluate(pred, lab)
+    assert m.confusion_matrix[1, 2] == 1  # actual 1 predicted 2
+    assert abs(m.total_accuracy - 5 / 6) < 1e-9
+    assert abs(m.micro_f1 - 5 / 6) < 1e-9
+    assert 0 < m.macro_f1 <= 1
+    assert "Accuracy" in m.summary()
+
+
+def test_binary_evaluator():
+    pred = np.array([True, True, False, False])
+    lab = np.array([True, False, True, False])
+    m = BinaryClassifierEvaluator().evaluate(pred, lab)
+    assert (m.tp, m.fp, m.fn, m.tn) == (1, 1, 1, 1)
+    assert m.accuracy == 0.5
+
+
+def test_mean_average_precision_perfect():
+    scores = np.array([[0.9, 0.1], [0.8, 0.6], [0.2, 0.7], [0.1, 0.95]])
+    actuals = [[0], [0], [1], [1]]
+    aps = MeanAveragePrecisionEvaluator(2).evaluate(actuals, scores)
+    np.testing.assert_allclose(aps, [1.0, 1.0], atol=1e-9)
+
+
+def test_augmented_examples_average():
+    # two source examples, two augmented copies each
+    scores = np.array(
+        [[0.6, 0.4], [0.4, 0.6], [0.1, 0.9], [0.2, 0.8]]
+    )
+    labels = np.array([0, 0, 1, 1])
+    names = ["a", "a", "b", "b"]
+    m = AugmentedExamplesEvaluator(names, 2).evaluate(scores, labels)
+    assert m.total_accuracy == 1.0
