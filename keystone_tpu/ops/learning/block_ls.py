@@ -213,16 +213,16 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     ) -> float:
         """Analytic flops/mem/net cost (reference:
         BlockLinearMapper.scala:268-282)."""
-        b = min(self.block_size, d)
-        iters = self.num_iter * max(1, (d + b - 1) // b)
-        flops = n * 1.0 * b * (b + k) / num_machines + b**3 + b * b * k
-        bytes_scanned = n * 1.0 * d / num_machines
-        network = (b * b + b * k) * jnp.log2(num_machines)
-        return float(
-            iters
-            * (
-                cpu_weight * flops
-                + mem_weight * bytes_scanned
-                + network_weight * float(network)
-            )
+        import math
+
+        flops = n * float(d) * (self.block_size + k) / num_machines
+        bytes_scanned = n * float(d) / num_machines + float(d) * k
+        network = (
+            2.0
+            * (float(d) * (self.block_size + k))
+            * max(math.log2(num_machines), 1.0)
+        )
+        return self.num_iter * (
+            max(cpu_weight * flops, mem_weight * bytes_scanned)
+            + network_weight * network
         )

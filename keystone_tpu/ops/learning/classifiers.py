@@ -1,0 +1,208 @@
+"""Probabilistic classifiers: Naive Bayes, logistic regression, LDA.
+
+Reference: nodes/learning/NaiveBayesModel.scala:21,62 (wraps MLlib
+NaiveBayes; model emits log-posteriors π + θx),
+LogisticRegressionModel.scala:19,42 (MLlib LBFGS LogisticGradient +
+SquaredL2Updater, multinomial support),
+LinearDiscriminantAnalysis.scala:17,39 (local multi-class LDA via
+eig(S_w⁻¹ S_b)). All are small models: the sufficient statistics are
+sharded-reduction matmuls; the solve/driver part is host/local.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.linalg
+from jax.experimental import sparse as jsparse
+
+from keystone_tpu.ops.learning.lbfgs import run_lbfgs
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.workflow.api import LabelEstimator, Transformer
+
+
+@dataclasses.dataclass(eq=False)
+class NaiveBayesModel(Transformer):
+    """x -> log-posterior scores π + θ·x (reference:
+    NaiveBayesModel.scala:21 — argmax downstream picks the class)."""
+
+    pi: Any  # (k,) log class priors
+    theta: Any  # (k, d) log feature likelihoods
+
+    def apply(self, x):
+        if isinstance(x, jsparse.BCOO):
+            return self.pi + x @ self.theta.T
+        return self.pi + x @ self.theta.T
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        x = ds.padded()
+        if isinstance(x, jsparse.BCOO):
+            scores = self.pi + jsparse.bcoo_dot_general(
+                x, self.theta.T, dimension_numbers=(([1], [0]), ([], []))
+            )
+        else:
+            scores = self.pi + x @ self.theta.T
+        return Dataset.from_array(scores * ds.mask()[:, None], n=ds.n)
+
+
+@dataclasses.dataclass(eq=False)
+class NaiveBayesEstimator(LabelEstimator):
+    """Multinomial NB with Laplace smoothing (reference:
+    NaiveBayesModel.scala:62 — MLlib NaiveBayes.train(lambda))."""
+
+    num_classes: int
+    lam: float = 1.0
+
+    def fit(self, data: Dataset, labels: Dataset) -> NaiveBayesModel:
+        y = np.asarray(labels.array()).reshape(-1).astype(np.int64)
+        x = data.padded()
+        onehot = jnp.asarray(
+            np.eye(self.num_classes, dtype=np.float32)[y]
+        )
+        # pad rows of x are zero so the (k, d) count matmul is exact
+        if isinstance(x, jsparse.BCOO):
+            counts = jsparse.bcoo_dot_general(
+                x, _pad_rows(onehot, x.shape[0]),
+                dimension_numbers=(([0], [0]), ([], [])),
+            ).T
+        else:
+            counts = _pad_rows(onehot, x.shape[0]).T @ x
+        class_counts = np.bincount(y, minlength=self.num_classes)
+        pi = jnp.log(
+            (jnp.asarray(class_counts, jnp.float32) + self.lam)
+        ) - np.log(len(y) + self.num_classes * self.lam)
+        totals = jnp.sum(counts, axis=1, keepdims=True)
+        theta = jnp.log(counts + self.lam) - jnp.log(
+            totals + self.lam * counts.shape[1]
+        )
+        return NaiveBayesModel(pi, theta)
+
+
+def _pad_rows(a: jnp.ndarray, n: int) -> jnp.ndarray:
+    if a.shape[0] == n:
+        return a
+    return jnp.concatenate(
+        [a, jnp.zeros((n - a.shape[0],) + a.shape[1:], a.dtype)]
+    )
+
+
+@dataclasses.dataclass(eq=False)
+class LogisticRegressionModel(Transformer):
+    """argmax-of-logits classifier (reference:
+    LogisticRegressionModel.scala:19 — MLlib model.predict)."""
+
+    W: Any  # (d, k)
+
+    def apply(self, x):
+        if isinstance(x, jsparse.BCOO):
+            scores = x @ self.W
+        else:
+            scores = x @ self.W
+        return jnp.argmax(scores, axis=-1)
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        x = ds.padded()
+        if isinstance(x, jsparse.BCOO):
+            scores = jsparse.bcoo_dot_general(
+                x, self.W, dimension_numbers=(([1], [0]), ([], []))
+            )
+        else:
+            scores = x @ self.W
+        return Dataset.from_array(jnp.argmax(scores, axis=-1), n=ds.n)
+
+
+@dataclasses.dataclass(eq=False)
+class LogisticRegressionEstimator(LabelEstimator):
+    """Multinomial logistic regression by full-batch L-BFGS (reference:
+    LogisticRegressionModel.scala:42 — MLlib LogisticRegressionWithLBFGS +
+    SquaredL2Updater). Softmax cross-entropy gradient is one jitted sharded
+    program; the L-BFGS driver is the shared host implementation."""
+
+    num_classes: int
+    num_iters: int = 20
+    reg_param: float = 0.0
+    convergence_tol: float = 1e-4
+
+    def fit(self, data: Dataset, labels: Dataset) -> LogisticRegressionModel:
+        y = np.asarray(labels.array()).reshape(-1).astype(np.int64)
+        data = data.to_array_mode()
+        x = data.padded()
+        n = data.n
+        d = x.shape[1]
+        k = self.num_classes
+        onehot = jnp.asarray(_pad_rows(
+            jnp.asarray(np.eye(k, dtype=np.float32)[y]), x.shape[0]
+        ))
+        mask = data.mask()
+        is_sparse = isinstance(x, jsparse.BCOO)
+
+        @jax.jit
+        def device_vg(W):
+            if is_sparse:
+                logits = jsparse.bcoo_dot_general(
+                    x, W, dimension_numbers=(([1], [0]), ([], []))
+                )
+            else:
+                logits = x @ W
+            logz = jax.scipy.special.logsumexp(logits, axis=1)
+            ll = jnp.sum(
+                (logz - jnp.sum(logits * onehot, axis=1)) * mask
+            )
+            p = jnp.exp(logits - logz[:, None]) * mask[:, None]
+            if is_sparse:
+                g = jsparse.bcoo_dot_general(
+                    x, p - onehot, dimension_numbers=(([0], [0]), ([], []))
+                )
+            else:
+                g = x.T @ (p - onehot)
+            return (
+                ll / n + 0.5 * self.reg_param * jnp.sum(W * W),
+                g / n + self.reg_param * W,
+            )
+
+        def vg(w_flat):
+            W = jnp.asarray(w_flat.reshape(d, k).astype(np.float32))
+            f, g = device_vg(W)
+            return float(f), np.asarray(g, np.float64).ravel()
+
+        w = run_lbfgs(
+            vg, np.zeros((d, k)), self.num_iters,
+            convergence_tol=self.convergence_tol,
+        )
+        return LogisticRegressionModel(
+            jnp.asarray(w.reshape(d, k).astype(np.float32))
+        )
+
+
+@dataclasses.dataclass(eq=False)
+class LinearDiscriminantAnalysis(LabelEstimator):
+    """Multi-class LDA: project onto the top eigenvectors of S_w⁻¹ S_b
+    (reference: LinearDiscriminantAnalysis.scala:17,39 — local eig)."""
+
+    num_dimensions: int
+
+    def fit(self, data: Dataset, labels: Dataset):
+        from keystone_tpu.ops.learning.linear import LinearMapper
+
+        X = np.asarray(data.array(), np.float64)
+        y = np.asarray(labels.array()).reshape(-1).astype(np.int64)
+        classes = np.unique(y)
+        d = X.shape[1]
+        overall_mean = X.mean(axis=0)
+        Sw = np.zeros((d, d))
+        Sb = np.zeros((d, d))
+        for c in classes:
+            Xc = X[y == c]
+            mu_c = Xc.mean(axis=0)
+            centered = Xc - mu_c
+            Sw += centered.T @ centered
+            diff = (mu_c - overall_mean)[:, None]
+            Sb += Xc.shape[0] * (diff @ diff.T)
+        evals, evecs = scipy.linalg.eig(Sb, Sw)
+        order = np.argsort(-evals.real)
+        W = evecs[:, order[: self.num_dimensions]].real
+        return LinearMapper(jnp.asarray(W, jnp.float32))

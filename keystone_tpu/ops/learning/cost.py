@@ -1,0 +1,38 @@
+"""Solver cost models.
+
+Reference: nodes/learning/CostModel.scala:6 — ``cost(n, d, k, sparsity,
+numMachines, cpuWeight, memWeight, networkWeight)``. The reference's
+empirical weights (cpu=3.8e-4, mem=2.9e-1, network=1.32) were fit on a
+16x r3.4xlarge cluster (LeastSquaresEstimator.scala:17,29-31); the TPU
+defaults below rescale them to a v5e chip's envelope: the flops term is
+normalized to MXU bf16 throughput, bytes-scanned to HBM bandwidth, and the
+network term to ICI all-reduce bandwidth. The *relative* formulas per
+solver (flops/mem/net) carry over unchanged — they count work, not
+hardware.
+"""
+
+from __future__ import annotations
+
+# cost-model unit weights for one TPU v5e chip, in seconds per unit:
+# cpu: 1 / (197e12 bf16 flops/s), mem: 1 / (819e9 HBM bytes/s) * 4 bytes,
+# network: per-hop ICI latency-ish constant for small collectives.
+TPU_CPU_WEIGHT = 1.0 / 197e12
+TPU_MEM_WEIGHT = 4.0 / 819e9
+TPU_NETWORK_WEIGHT = 1e-6
+
+
+class CostModel:
+    """Mix-in: analytic cost of running this operator."""
+
+    def cost(
+        self,
+        n: int,
+        d: int,
+        k: int,
+        sparsity: float,
+        num_machines: int,
+        cpu_weight: float = TPU_CPU_WEIGHT,
+        mem_weight: float = TPU_MEM_WEIGHT,
+        network_weight: float = TPU_NETWORK_WEIGHT,
+    ) -> float:
+        raise NotImplementedError

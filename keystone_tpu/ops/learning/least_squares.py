@@ -1,0 +1,100 @@
+"""Cost-model-driven least-squares solver auto-selection.
+
+Reference: nodes/learning/LeastSquaresEstimator.scala:26-87 — an
+OptimizableLabelEstimator whose physical options are Dense LBFGS,
+Sparsify→Sparse LBFGS, Densify→BlockLS(1000, 3), and Densify→Exact
+NormalEquations; picks minBy(cost(n, d, k, sparsity, numMachines, ...)).
+The TPU cost weights live in cost.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from keystone_tpu.ops.learning.block_ls import BlockLeastSquaresEstimator
+from keystone_tpu.ops.learning.cost import (
+    TPU_CPU_WEIGHT,
+    TPU_MEM_WEIGHT,
+    TPU_NETWORK_WEIGHT,
+)
+from keystone_tpu.ops.learning.lbfgs import (
+    DenseLBFGSwithL2,
+    SparseLBFGSwithL2,
+)
+from keystone_tpu.ops.learning.linear import LinearMapEstimator
+from keystone_tpu.ops.util.nodes import Densify, Sparsify
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.workflow.api import LabelEstimator
+from keystone_tpu.workflow.chain_utils import TransformerLabelEstimatorChain
+from keystone_tpu.workflow.node_optimization import Optimizable
+
+
+@dataclasses.dataclass(eq=False)
+class LeastSquaresEstimator(LabelEstimator, Optimizable):
+    lam: float = 0.0
+    num_machines: Optional[int] = None
+    cpu_weight: float = TPU_CPU_WEIGHT
+    mem_weight: float = TPU_MEM_WEIGHT
+    network_weight: float = TPU_NETWORK_WEIGHT
+
+    def _options(self):
+        dense_lbfgs = DenseLBFGSwithL2(
+            reg_param=self.lam, num_iterations=20
+        )
+        sparse_lbfgs = SparseLBFGSwithL2(
+            reg_param=self.lam, num_iterations=20
+        )
+        block = BlockLeastSquaresEstimator(1000, 3, lam=self.lam)
+        exact = LinearMapEstimator(lam=self.lam)
+        return [
+            (dense_lbfgs, dense_lbfgs),
+            (
+                sparse_lbfgs,
+                TransformerLabelEstimatorChain(Sparsify(), sparse_lbfgs),
+            ),
+            (block, TransformerLabelEstimatorChain(Densify(), block)),
+            (exact, TransformerLabelEstimatorChain(Densify(), exact)),
+        ]
+
+    @property
+    def default(self) -> LabelEstimator:
+        return DenseLBFGSwithL2(reg_param=self.lam, num_iterations=20)
+
+    def fit(self, data: Dataset, labels: Dataset):
+        chosen = self.optimize([data, labels], data.n)
+        return chosen.fit(data, labels)
+
+    def fit_datasets(self, datasets):
+        return self.fit(datasets[0], datasets[1])
+
+    def optimize(self, samples, n_total: int) -> LabelEstimator:
+        sample: Dataset = Dataset.of(samples[0])
+        sample_labels: Dataset = Dataset.of(samples[1])
+        first = sample.first()
+        n = max(n_total, sample.n)
+        if isinstance(first, jsparse.BCOO):
+            d = int(np.prod(first.shape))
+            sparsity = float(first.nse) / max(d, 1)
+        else:
+            arr = np.asarray(first)
+            d = int(arr.reshape(-1).shape[0])
+            nz = float(np.count_nonzero(arr))
+            sparsity = nz / max(d, 1)
+        k = int(np.asarray(sample_labels.first()).reshape(-1).shape[0])
+        machines = self.num_machines or max(len(jax.devices()), 1)
+        return min(
+            self._options(),
+            key=lambda o: o[0].cost(
+                n, d, k, sparsity, machines,
+                self.cpu_weight, self.mem_weight, self.network_weight,
+            ),
+        )[1]
+
+    @property
+    def weight(self) -> int:
+        return self.default.weight

@@ -74,6 +74,20 @@ class LinearMapEstimator(LabelEstimator):
         W = jnp.asarray(psd_solve_host(gram, rhs, self.lam), A.dtype)
         return LinearMapper(W)
 
+    def cost(self, n, d, k, sparsity, num_machines, cpu_weight, mem_weight,
+             network_weight):
+        """Exact normal-equations cost (reference:
+        LinearMapper.scala:100-115)."""
+        import math
+
+        flops = n * float(d) * (d + k) / num_machines
+        bytes_scanned = n * float(d) / num_machines + float(d) * d
+        network = float(d) * (d + k)
+        return (
+            max(cpu_weight * flops, mem_weight * bytes_scanned)
+            + network_weight * network
+        )
+
     @staticmethod
     def compute_cost(
         data: Dataset, labels: Dataset, lam: float, W, intercept=None

@@ -1,0 +1,262 @@
+"""PCA family: local SVD, distributed TSQR, randomized sketch, and the
+cost-model-selected column variant.
+
+Reference: nodes/learning/PCA.scala (PCATransformer:19,
+BatchPCATransformer:38, PCAEstimator:163-225 with MATLAB sign convention
+:227-248, ColumnPCAEstimator:51-156), DistributedPCA.scala:20 (mlmatrix
+TSQR), ApproximatePCA.scala:22 (Halko-Martinsson-Tropp randomized range
+finder).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.ops.learning.cost import CostModel
+from keystone_tpu.parallel import linalg as plinalg
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.workflow.api import Estimator, Transformer
+from keystone_tpu.workflow.node_optimization import Optimizable
+
+
+def enforce_matlab_pca_sign_convention(pca: jnp.ndarray) -> jnp.ndarray:
+    """Largest-|element| entry of each column gets a positive sign
+    (reference: PCA.scala:227-248)."""
+    col_maxs = jnp.max(pca, axis=0)
+    abs_col_maxs = jnp.max(jnp.abs(pca), axis=0)
+    signs = jnp.where(col_maxs == abs_col_maxs, 1.0, -1.0)
+    return pca * signs[None, :]
+
+
+@dataclasses.dataclass(eq=False)
+class PCATransformer(Transformer):
+    """x -> pca_matᵀ x for vectors (reference: PCA.scala:19)."""
+
+    pca_mat: Any  # (d, dims)
+
+    def apply(self, x):
+        return x @ self.pca_mat
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        return Dataset.from_array(ds.padded() @ self.pca_mat, n=ds.n)
+
+
+@dataclasses.dataclass(eq=False)
+class BatchPCATransformer(Transformer):
+    """(d, m) descriptor matrix -> (dims, m) (reference: PCA.scala:38 —
+    pcaMat.t * in)."""
+
+    pca_mat: Any  # (d, dims)
+    vmap_batch = True
+
+    def apply(self, m):
+        return self.pca_mat.T @ m
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        if ds.is_array:
+            x = ds.padded()  # (n, d, m)
+            return Dataset.from_array(
+                jnp.einsum("dk,ndm->nkm", self.pca_mat, x), n=ds.n
+            )
+        return ds.map(self.apply)
+
+
+def _compute_pca(data_mat: jnp.ndarray, dims: int) -> jnp.ndarray:
+    """Center, SVD, sign convention, truncate (reference:
+    PCA.scala:180-203 computePCA)."""
+    means = jnp.mean(data_mat, axis=0)
+    centered = data_mat - means
+    _, _, vt = jnp.linalg.svd(centered, full_matrices=False)
+    pca = enforce_matlab_pca_sign_convention(vt.T)
+    return pca[:, :dims]
+
+
+@dataclasses.dataclass(eq=False)
+class PCAEstimator(Estimator, CostModel):
+    """Local PCA: materialize the sample, one SVD (reference:
+    PCA.scala:163-225 — collect + LAPACK sgesvd; here the SVD runs on
+    device)."""
+
+    dims: int
+
+    def fit(self, data: Dataset) -> PCATransformer:
+        x = data.array()
+        return PCATransformer(_compute_pca(jnp.asarray(x), self.dims))
+
+    def cost(self, n, d, k, sparsity, num_machines, cpu_weight, mem_weight,
+             network_weight):
+        # reference: PCA.scala:205-225 — collect everything to one place
+        flops = float(n) * d * d
+        bytes_scanned = float(n) * d
+        network = float(n) * d
+        return (
+            max(cpu_weight * flops, mem_weight * bytes_scanned)
+            + network_weight * network
+        )
+
+
+@dataclasses.dataclass(eq=False)
+class DistributedPCAEstimator(Estimator, CostModel):
+    """Distributed PCA via TSQR: R of the sharded centered matrix, then a
+    local SVD of R (reference: DistributedPCA.scala:20,34-57 — mlmatrix
+    `new TSQR().qrR` + driver-side SVD)."""
+
+    dims: int
+
+    def fit(self, data: Dataset) -> PCATransformer:
+        ds = data.to_array_mode()
+        x = ds.padded()
+        mask = ds.mask()
+        mu = jnp.sum(x * mask[:, None], axis=0) / ds.n
+        centered = (x - mu) * mask[:, None]
+        r = plinalg.tsqr_r(centered)
+        _, _, vt = jnp.linalg.svd(r, full_matrices=False)
+        pca = enforce_matlab_pca_sign_convention(vt.T)
+        return PCATransformer(pca[:, : self.dims])
+
+    def cost(self, n, d, k, sparsity, num_machines, cpu_weight, mem_weight,
+             network_weight):
+        # reference: DistributedPCA.scala:59-73 — n d²/m + d³ log m
+        flops = float(n) * d * d / num_machines + float(d) ** 3 * max(
+            np.log2(num_machines), 1.0
+        )
+        bytes_scanned = float(n) * d / num_machines
+        network = float(d) * d * max(np.log2(num_machines), 1.0)
+        return (
+            max(cpu_weight * flops, mem_weight * bytes_scanned)
+            + network_weight * network
+        )
+
+
+@dataclasses.dataclass(eq=False)
+class ApproximatePCAEstimator(Estimator, CostModel):
+    """Randomized sketch PCA (Halko-Martinsson-Tropp algs 4.4 + 5.1;
+    reference: ApproximatePCA.scala:22,37,67): range finder with ``q``
+    power iterations on an (n, dims+p) sketch, then SVD of the small
+    projected matrix."""
+
+    dims: int
+    p: int = 10  # oversampling
+    q: int = 2  # power iterations
+    seed: int = 0
+
+    def fit(self, data: Dataset) -> PCATransformer:
+        ds = data.to_array_mode()
+        x = ds.padded()
+        mask = ds.mask()
+        mu = jnp.sum(x * mask[:, None], axis=0) / ds.n
+        A = (x - mu) * mask[:, None]
+        d = A.shape[1]
+        l = min(self.dims + self.p, d)
+        key = jax.random.PRNGKey(self.seed)
+        omega = jax.random.normal(key, (d, l), jnp.float32)
+        Y = A @ omega
+        Q, _ = jnp.linalg.qr(Y)
+        for _ in range(self.q):  # power iterations for spectral decay
+            Z, _ = jnp.linalg.qr(A.T @ Q)
+            Q, _ = jnp.linalg.qr(A @ Z)
+        B = Q.T @ A  # (l, d)
+        _, _, vt = jnp.linalg.svd(B, full_matrices=False)
+        pca = enforce_matlab_pca_sign_convention(vt.T)
+        return PCATransformer(pca[:, : self.dims])
+
+    def cost(self, n, d, k, sparsity, num_machines, cpu_weight, mem_weight,
+             network_weight):
+        l = self.dims + self.p
+        flops = float(n) * d * l * (1 + self.q) / num_machines
+        bytes_scanned = float(n) * d / num_machines
+        network = float(d) * l
+        return (
+            max(cpu_weight * flops, mem_weight * bytes_scanned)
+            + network_weight * network
+        )
+
+
+def _columns_dataset(data: Dataset) -> Dataset:
+    """Flatten a dataset of (d, m) descriptor matrices into one (N, d)
+    array of descriptor columns (reference: LocalColumnPCAEstimator —
+    flatMap(matrixToColArray))."""
+    cols: List[np.ndarray] = []
+    for m in data.items():
+        cols.append(np.asarray(m).T)
+    return Dataset.from_array(jnp.asarray(np.concatenate(cols, axis=0)))
+
+
+@dataclasses.dataclass(eq=False)
+class LocalColumnPCAEstimator(Estimator, CostModel):
+    """Column-wise local PCA over matrix items (reference:
+    PCA.scala:51-70)."""
+
+    dims: int
+
+    def fit(self, data: Dataset) -> BatchPCATransformer:
+        t = PCAEstimator(self.dims).fit(_columns_dataset(data))
+        return BatchPCATransformer(t.pca_mat)
+
+    def cost(self, *a, **kw):
+        return PCAEstimator(self.dims).cost(*a, **kw)
+
+
+@dataclasses.dataclass(eq=False)
+class DistributedColumnPCAEstimator(Estimator, CostModel):
+    """Column-wise distributed PCA (reference: PCA.scala:81-102)."""
+
+    dims: int
+
+    def fit(self, data: Dataset) -> BatchPCATransformer:
+        t = DistributedPCAEstimator(self.dims).fit(
+            _columns_dataset(data).shard()
+        )
+        return BatchPCATransformer(t.pca_mat)
+
+    def cost(self, *a, **kw):
+        return DistributedPCAEstimator(self.dims).cost(*a, **kw)
+
+
+@dataclasses.dataclass(eq=False)
+class ColumnPCAEstimator(Estimator, Optimizable):
+    """Cost-model choice between local and distributed column PCA
+    (reference: PCA.scala:118-156 — OptimizableEstimator)."""
+
+    dims: int
+    num_machines: Optional[int] = None
+
+    def _options(self):
+        return [
+            LocalColumnPCAEstimator(self.dims),
+            DistributedColumnPCAEstimator(self.dims),
+        ]
+
+    def fit(self, data: Dataset):
+        return LocalColumnPCAEstimator(self.dims).fit(data)
+
+    def fit_datasets(self, datasets):
+        return self.fit(datasets[0])
+
+    def optimize(self, samples, n_total: int):
+        sample: Dataset = samples[0]
+        first = np.asarray(sample.first())
+        d = first.shape[0]
+        cols_per_item = first.shape[1] if first.ndim > 1 else 1
+        n = max(n_total, sample.n) * cols_per_item
+        machines = self.num_machines or max(
+            len(jax.devices()), 1
+        )
+        from keystone_tpu.ops.learning.cost import (
+            TPU_CPU_WEIGHT,
+            TPU_MEM_WEIGHT,
+            TPU_NETWORK_WEIGHT,
+        )
+
+        return min(
+            self._options(),
+            key=lambda o: o.cost(
+                n, d, self.dims, 1.0, machines,
+                TPU_CPU_WEIGHT, TPU_MEM_WEIGHT, TPU_NETWORK_WEIGHT,
+            ),
+        )

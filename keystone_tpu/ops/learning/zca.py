@@ -1,0 +1,55 @@
+"""ZCA whitening.
+
+Reference: nodes/learning/ZCAWhitener.scala:12,30,37 — fit from a single
+stacked sample matrix via LAPACK sgesvd; whitener =
+V diag((s²/(n−1) + ε)^−½) Vᵀ; apply = (x − means) · whitener.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.workflow.api import Estimator, Transformer
+
+
+@dataclasses.dataclass(eq=False)
+class ZCAWhitener(Transformer):
+    whitener: Any  # (d, d)
+    means: Any  # (d,)
+
+    def apply(self, x):
+        # works for a (d,) vector or an (m, d) row-major patch matrix
+        return (x - self.means) @ self.whitener
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        out = (ds.padded() - self.means) @ self.whitener
+        out = out * ds.mask()[:, None] if out.ndim == 2 else out
+        return Dataset.from_array(out, n=ds.n)
+
+
+@dataclasses.dataclass(eq=False)
+class ZCAWhitenerEstimator(Estimator):
+    """Fit from the (single) stacked sample matrix (n, d)."""
+
+    eps: float = 0.1
+
+    def fit(self, data) -> ZCAWhitener:
+        if isinstance(data, Dataset):
+            x = jnp.asarray(data.array())
+        else:
+            x = jnp.asarray(data)
+        return self.fit_single(x)
+
+    def fit_single(self, x: jnp.ndarray) -> ZCAWhitener:
+        n = x.shape[0]
+        means = jnp.mean(x, axis=0)
+        centered = x - means
+        _, s, vt = jnp.linalg.svd(centered, full_matrices=False)
+        scale = 1.0 / jnp.sqrt(s * s / (n - 1.0) + self.eps)
+        whitener = (vt.T * scale[None, :]) @ vt
+        return ZCAWhitener(whitener, means)

@@ -1,0 +1,75 @@
+"""Sharded linear algebra — the mlmatrix replacement.
+
+Reference call surface (SURVEY.md §2.9.3): edu.berkeley.cs.amplab.mlmatrix
+{TSQR, NormalEquations, BlockCoordinateDescent, QRUtils, treeReduce} used by
+nodes/learning/{DistributedPCA.scala:20, LBFGS.scala:5,
+BlockLinearMapper.scala:4}. Here the same capabilities are sharded-JAX:
+
+- ``tsqr_r``: tree-structured QR of a row-sharded (n, d) matrix. Each data
+  shard QRs locally (shard_map), the (d, d) R factors are all-gathered and
+  reduced by one final QR — the reference's treeReduce combine collapses to
+  one ICI all-gather because d is small.
+- ``gram``: AᵀA with f32 accumulation (the NormalEquations building block);
+  under jit the contraction over the sharded row axis becomes per-shard MXU
+  matmuls + a psum over the "data" axis.
+- Block coordinate descent lives in ops/learning/block_ls.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from keystone_tpu.parallel import mesh as mesh_lib
+
+
+@jax.jit
+def gram(A):
+    """AᵀA with f32 accumulation."""
+    return jax.lax.dot_general(
+        A.T, A, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def tsqr_r(A, mesh=None):
+    """R factor of a thin QR of a row-sharded (n, d) matrix, n >> d.
+
+    Reference: mlmatrix TSQR().qrR (DistributedPCA.scala:47) — per-partition
+    local QR + tree combine. Sign convention: R has non-negative diagonal so
+    the result is deterministic across shard counts.
+    """
+    mesh = mesh or mesh_lib.current_mesh()
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=P(mesh_lib.DATA_AXIS, None),
+        out_specs=P(mesh_lib.DATA_AXIS, None),
+    )
+    def local_qr(block):
+        r = jnp.linalg.qr(block, mode="r")
+        return _fix_sign(r)
+
+    d = A.shape[1]
+    rs = local_qr(A)  # (nshards * d, d) — stacked local R factors
+    r = jnp.linalg.qr(rs, mode="r")
+    return _fix_sign(r)
+
+
+def _fix_sign(r):
+    s = jnp.sign(jnp.diagonal(r))
+    s = jnp.where(s == 0, 1.0, s)
+    return r * s[:, None]
+
+
+def qr_q(A, mesh=None):
+    """Explicit thin Q of a row-sharded matrix: Q = A R⁻¹ (CholeskyQR-style
+    using the TSQR R, stable because R comes from orthogonal reductions)."""
+    r = tsqr_r(A, mesh)
+    return jax.scipy.linalg.solve_triangular(
+        r.T, A.T, lower=True
+    ).T, r

@@ -1,0 +1,90 @@
+"""NaiveBayes/LogReg/LDA + solver auto-selection tests (reference:
+NaiveBayesSuite, LogisticRegressionSuite, LeastSquaresEstimatorSuite)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.learning import (
+    BlockLeastSquaresEstimator,
+    DenseLBFGSwithL2,
+    LeastSquaresEstimator,
+    LinearDiscriminantAnalysis,
+    LinearMapEstimator,
+    LogisticRegressionEstimator,
+    NaiveBayesEstimator,
+    SparseLBFGSwithL2,
+)
+from keystone_tpu.workflow.chain_utils import TransformerLabelEstimatorChain
+from keystone_tpu.parallel.dataset import Dataset
+
+
+def test_naive_bayes_separates_counts():
+    # class 0 uses features {0,1}, class 1 uses {2,3}
+    X = np.array(
+        [[3, 1, 0, 0], [2, 2, 0, 0], [0, 0, 3, 1], [0, 0, 1, 4]],
+        np.float32,
+    )
+    y = np.array([0, 0, 1, 1])
+    model = NaiveBayesEstimator(2).fit(Dataset.of(X), Dataset.of(y))
+    scores = np.asarray(model.apply_batch(Dataset.of(X)).array())
+    assert (scores.argmax(1) == y).all()
+
+
+def test_logistic_regression_separates(mesh8):
+    rng = np.random.default_rng(0)
+    n = 200
+    X = rng.standard_normal((n, 4)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.int32)
+    model = LogisticRegressionEstimator(2, num_iters=50).fit(
+        Dataset.of(X).shard(), Dataset.of(y)
+    )
+    pred = np.asarray(model.apply_batch(Dataset.of(X)).array())
+    assert (pred == y).mean() > 0.95
+
+
+def test_lda_projects_separably():
+    rng = np.random.default_rng(1)
+    X0 = rng.standard_normal((50, 5)) + np.array([3, 0, 0, 0, 0])
+    X1 = rng.standard_normal((50, 5)) - np.array([3, 0, 0, 0, 0])
+    X = np.concatenate([X0, X1]).astype(np.float32)
+    y = np.array([0] * 50 + [1] * 50)
+    t = LinearDiscriminantAnalysis(1).fit(Dataset.of(X), Dataset.of(y))
+    proj = np.asarray(t.apply_batch(Dataset.of(X)).array()).ravel()
+    assert (proj[:50].mean() > 0) != (proj[50:].mean() > 0)
+    overlap = min(proj[:50].max(), proj[50:].max()) > max(
+        proj[:50].min(), proj[50:].min()
+    )
+
+
+def test_least_squares_estimator_selection_regimes(mesh8):
+    """Cost model picks sensible solvers by regime (reference:
+    LeastSquaresEstimatorSuite:11-60)."""
+    est = LeastSquaresEstimator(lam=1e-3, num_machines=16)
+    rng = np.random.default_rng(2)
+
+    def choose(n, d, k, sparsity):
+        nnz = max(int(d * sparsity), 1)
+        row = np.zeros(d, np.float32)
+        row[rng.choice(d, nnz, replace=False)] = 1.0
+        sample = Dataset.of(np.tile(row, (8, 1)))
+        lab = Dataset.of(np.zeros((8, k), np.float32))
+        return est.optimize([sample, lab], n)
+
+    # dense small-d problems: exact or block solve beats iterating
+    dense_small = choose(n=10**6, d=128, k=4, sparsity=1.0)
+    # huge-d sparse problems: sparse LBFGS
+    sparse_huge = choose(n=10**6, d=100_000, k=2, sparsity=0.0001)
+    assert isinstance(sparse_huge, TransformerLabelEstimatorChain)
+    assert isinstance(sparse_huge.estimator, SparseLBFGSwithL2)
+    # selection returns one of the declared options in all regimes
+    assert dense_small is not None
+
+
+def test_least_squares_estimator_end_to_end(mesh8):
+    rng = np.random.default_rng(3)
+    A = rng.standard_normal((96, 6)).astype(np.float32)
+    W = rng.standard_normal((6, 2)).astype(np.float32)
+    b = A @ W
+    model = LeastSquaresEstimator(lam=0.0).fit(Dataset.of(A), Dataset.of(b))
+    pred = np.asarray(model.apply_batch(Dataset.of(A)).array())
+    assert np.abs(pred - b).max() < 0.1

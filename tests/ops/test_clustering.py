@@ -1,0 +1,70 @@
+"""KMeans++/GMM tests (reference: KMeansPlusPlusSuite,
+GaussianMixtureModelSuite)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.learning import (
+    GaussianMixtureModel,
+    GaussianMixtureModelEstimator,
+    KMeansPlusPlusEstimator,
+)
+from keystone_tpu.parallel.dataset import Dataset
+
+
+def _blobs(n_per, centers, spread=0.1, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = [
+        c + spread * rng.standard_normal((n_per, len(c)))
+        for c in centers
+    ]
+    return np.concatenate(xs).astype(np.float32)
+
+
+def test_kmeans_recovers_blobs():
+    centers = [np.array([0.0, 0.0]), np.array([5.0, 5.0]), np.array([-5.0, 5.0])]
+    X = _blobs(60, centers, seed=0)
+    model = KMeansPlusPlusEstimator(3, 20, seed=0).fit(Dataset.of(X))
+    means = np.asarray(model.means)
+    # each true center has a learned center nearby
+    for c in centers:
+        assert np.min(np.linalg.norm(means - c, axis=1)) < 0.5
+
+
+def test_kmeans_assignment_one_hot():
+    X = _blobs(10, [np.array([0.0, 0.0]), np.array([9.0, 9.0])], seed=1)
+    model = KMeansPlusPlusEstimator(2, 5, seed=0).fit(Dataset.of(X))
+    assign = np.asarray(model.apply_batch(Dataset.of(X)).array())
+    assert assign.shape == (20, 2)
+    np.testing.assert_allclose(assign.sum(1), np.ones(20))
+    assert set(np.unique(assign)) <= {0.0, 1.0}
+
+
+def test_gmm_em_recovers_blobs():
+    centers = [np.array([0.0, 0.0]), np.array([6.0, 6.0])]
+    X = _blobs(200, centers, spread=0.5, seed=2)
+    gmm = GaussianMixtureModelEstimator(
+        2, max_iterations=50, min_cluster_size=10, seed=0
+    ).fit(Dataset.of(X))
+    mu = np.asarray(gmm.means).T  # (k, d)
+    for c in centers:
+        assert np.min(np.linalg.norm(mu - c, axis=1)) < 0.5
+    # posteriors are a (thresholded) distribution
+    q = np.asarray(gmm.apply_batch(Dataset.of(X)).array())
+    np.testing.assert_allclose(q.sum(1), np.ones(len(X)), atol=1e-5)
+
+
+def test_gmm_csv_load(tmp_path):
+    means = np.array([[0.0, 1.0], [2.0, 3.0]])  # (d=2, k=2)
+    variances = np.ones((2, 2))
+    weights = np.array([0.4, 0.6])
+    mf, vf, wf = (
+        tmp_path / "m.csv", tmp_path / "v.csv", tmp_path / "w.csv"
+    )
+    np.savetxt(mf, means, delimiter=",")
+    np.savetxt(vf, variances, delimiter=",")
+    np.savetxt(wf, weights, delimiter=",")
+    gmm = GaussianMixtureModel.load(str(mf), str(vf), str(wf))
+    assert gmm.k == 2 and gmm.dim == 2
+    out = gmm.apply(np.array([0.0, 2.0], np.float32))
+    assert out.shape == (2,)

@@ -1,0 +1,56 @@
+"""L-BFGS tests (reference: LBFGSSuite — distributed vs local solutions)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.learning import (
+    DenseLBFGSwithL2,
+    SparseLBFGSwithL2,
+)
+from keystone_tpu.ops.util.nodes import Sparsify
+from keystone_tpu.parallel.dataset import Dataset
+
+
+def test_dense_lbfgs_recovers_ols(mesh8):
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((128, 10)).astype(np.float32)
+    W_true = rng.standard_normal((10, 3)).astype(np.float32)
+    b = A @ W_true + 0.7
+    est = DenseLBFGSwithL2(num_iterations=60, reg_param=0.0)
+    model = est.fit(Dataset.of(A).shard(), Dataset.of(b).shard())
+    pred = np.asarray(model.apply_batch(Dataset.of(A)).array())
+    assert np.abs(pred - b).max() < 0.05
+
+
+def test_dense_lbfgs_l2_matches_ridge(mesh8):
+    rng = np.random.default_rng(1)
+    A = rng.standard_normal((200, 6)).astype(np.float32)
+    b = rng.standard_normal((200, 2)).astype(np.float32)
+    lam = 0.1
+    n = A.shape[0]
+    est = DenseLBFGSwithL2(
+        num_iterations=100, reg_param=lam, fit_intercept=False,
+        convergence_tol=1e-10,
+    )
+    model = est.fit(Dataset.of(A), Dataset.of(b))
+    # objective: ||AW-b||^2/(2n) + lam/2 ||W||^2  =>  (A'A/n + lam I) W = A'b/n
+    expect = np.linalg.solve(A.T @ A / n + lam * np.eye(6), A.T @ b / n)
+    np.testing.assert_allclose(np.asarray(model.W), expect, atol=5e-3)
+
+
+def test_sparse_lbfgs_runs(mesh8):
+    rng = np.random.default_rng(2)
+    A = (rng.standard_normal((64, 8)) * (rng.random((64, 8)) < 0.3)).astype(
+        np.float32
+    )
+    W_true = rng.standard_normal((8, 2)).astype(np.float32)
+    b = (A @ W_true).astype(np.float32)
+    sparse_ds = Sparsify().apply_batch(Dataset.of(A))
+    est = SparseLBFGSwithL2(num_iterations=60)
+    model = est.fit(sparse_ds, Dataset.of(b))
+    pred = np.asarray(model.apply_batch(sparse_ds).array())
+    assert np.abs(pred - b).max() < 0.05
+
+
+def test_lbfgs_weight():
+    assert DenseLBFGSwithL2(num_iterations=20).weight == 21
