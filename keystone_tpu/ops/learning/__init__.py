@@ -41,6 +41,17 @@ from keystone_tpu.ops.learning.classifiers import (
     NaiveBayesEstimator,
     NaiveBayesModel,
 )
+from keystone_tpu.ops.learning.weighted_ls import (
+    BlockWeightedLeastSquaresEstimator,
+    PerClassWeightedLeastSquaresEstimator,
+)
+from keystone_tpu.ops.learning.kernel import (
+    GaussianKernelGenerator,
+    GaussianKernelTransformer,
+    KernelBlockLinearMapper,
+    KernelMatrix,
+    KernelRidgeRegression,
+)
 from keystone_tpu.ops.learning.cost import CostModel
 
 __all__ = [
@@ -48,6 +59,13 @@ __all__ = [
     "BatchPCATransformer",
     "BlockLeastSquaresEstimator",
     "BlockLinearMapper",
+    "BlockWeightedLeastSquaresEstimator",
+    "GaussianKernelGenerator",
+    "GaussianKernelTransformer",
+    "KernelBlockLinearMapper",
+    "KernelMatrix",
+    "KernelRidgeRegression",
+    "PerClassWeightedLeastSquaresEstimator",
     "ColumnPCAEstimator",
     "CostModel",
     "DenseLBFGSwithL2",

@@ -99,9 +99,12 @@ class BlockLinearMapper(Transformer):
     block_size: int
     feature_mean: Optional[Any] = None  # (D,)
     label_mean: Optional[Any] = None  # (k,)
+    explicit_intercept: Optional[Any] = None  # (k,); weighted solver sets it
 
     @property
     def intercept(self):
+        if self.explicit_intercept is not None:
+            return self.explicit_intercept
         if self.label_mean is None:
             return None
         fm = 0.0 if self.feature_mean is None else self.feature_mean

@@ -34,8 +34,6 @@ class NaiveBayesModel(Transformer):
     theta: Any  # (k, d) log feature likelihoods
 
     def apply(self, x):
-        if isinstance(x, jsparse.BCOO):
-            return self.pi + x @ self.theta.T
         return self.pi + x @ self.theta.T
 
     def apply_batch(self, ds: Dataset) -> Dataset:
@@ -98,11 +96,7 @@ class LogisticRegressionModel(Transformer):
     W: Any  # (d, k)
 
     def apply(self, x):
-        if isinstance(x, jsparse.BCOO):
-            scores = x @ self.W
-        else:
-            scores = x @ self.W
-        return jnp.argmax(scores, axis=-1)
+        return jnp.argmax(x @ self.W, axis=-1)
 
     def apply_batch(self, ds: Dataset) -> Dataset:
         x = ds.padded()

@@ -78,8 +78,6 @@ class LinearMapEstimator(LabelEstimator):
              network_weight):
         """Exact normal-equations cost (reference:
         LinearMapper.scala:100-115)."""
-        import math
-
         flops = n * float(d) * (d + k) / num_machines
         bytes_scanned = n * float(d) / num_machines + float(d) * d
         network = float(d) * (d + k)

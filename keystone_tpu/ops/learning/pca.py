@@ -233,7 +233,10 @@ class ColumnPCAEstimator(Estimator, Optimizable):
         ]
 
     def fit(self, data: Dataset):
-        return LocalColumnPCAEstimator(self.dims).fit(data)
+        # consult the cost model eagerly (reference default is the
+        # distributed estimator, PCA.scala:128; the graph-level
+        # NodeOptimizationRule replaces this node when sampling is possible)
+        return self.optimize([data], data.n).fit(data)
 
     def fit_datasets(self, datasets):
         return self.fit(datasets[0])

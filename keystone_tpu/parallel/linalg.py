@@ -54,7 +54,6 @@ def tsqr_r(A, mesh=None):
         r = jnp.linalg.qr(block, mode="r")
         return _fix_sign(r)
 
-    d = A.shape[1]
     rs = local_qr(A)  # (nshards * d, d) — stacked local R factors
     r = jnp.linalg.qr(rs, mode="r")
     return _fix_sign(r)

@@ -1,0 +1,129 @@
+"""Weighted least-squares tests against a direct numpy f64 translation of
+the reference algorithm (reference: BlockWeightedLeastSquaresSuite —
+distributed vs local solutions on CSV fixtures, incl. shuffled variants)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.learning.weighted_ls import (
+    BlockWeightedLeastSquaresEstimator,
+    PerClassWeightedLeastSquaresEstimator,
+)
+from keystone_tpu.parallel.dataset import Dataset
+
+
+def ref_block_weighted_bcd(X, Y, block_size, num_iter, lam, w):
+    """numpy f64 translation of BlockWeightedLeastSquares.scala:139-314."""
+    X = X.astype(np.float64)
+    Y = Y.astype(np.float64)
+    n, D = X.shape
+    C = Y.shape[1]
+    class_of = Y.argmax(1)
+    counts = np.bincount(class_of, minlength=C)
+    jlm = 2 * w + 2 * (1 - w) * counts / n - 1
+    R = Y - jlm[None, :]
+    blocks = [(s, min(s + block_size, D)) for s in range(0, D, block_size)]
+    W = np.zeros((D, C))
+    jm_full = np.zeros((C, D))
+    for _ in range(num_iter):
+        for (s, e) in blocks:
+            Xb = X[:, s:e]
+            res_mean = R.mean(0)
+            pop_mean = Xb.mean(0)
+            pop_cov = Xb.T @ Xb / n - np.outer(pop_mean, pop_mean)
+            pop_xtr = Xb.T @ R / n
+            delta = np.zeros((e - s, C))
+            for c in range(C):
+                rows = class_of == c
+                Xc = Xb[rows]
+                nc = counts[c]
+                cmean = Xc.mean(0)
+                Xz = Xc - cmean
+                ccov = Xz.T @ Xz / nc
+                rl = R[rows, c]
+                cxtr = Xc.T @ rl / nc
+                md = cmean - pop_mean
+                jxtx = (
+                    pop_cov * (1 - w)
+                    + ccov * w
+                    + np.outer(md, md) * (1 - w) * w
+                )
+                mmw = res_mean[c] * (1 - w) + w * rl.mean()
+                jm = cmean * w + pop_mean * (1 - w)
+                jxtr = pop_xtr[:, c] * (1 - w) + cxtr * w - jm * mmw
+                delta[:, c] = np.linalg.solve(
+                    jxtx + lam * np.eye(e - s), jxtr - W[s:e, c] * lam
+                )
+                jm_full[c, s:e] = jm
+            W[s:e] += delta
+            R = R - Xb @ delta
+    b = jlm - np.einsum("cd,dc->c", jm_full, W)
+    return W, b
+
+
+def _weighted_problem(n=90, D=10, C=3, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, C, n)
+    centers = rng.standard_normal((C, D)) * 2
+    X = (centers[y] + rng.standard_normal((n, D))).astype(np.float32)
+    Y = (2.0 * np.eye(C, dtype=np.float32)[y] - 1.0)
+    return X, Y, y
+
+
+@pytest.mark.parametrize("num_iter,block_size", [(1, 10), (2, 4)])
+def test_block_weighted_matches_reference_translation(
+    mesh8, num_iter, block_size
+):
+    X, Y, _ = _weighted_problem()
+    lam, w = 0.1, 0.6
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size, num_iter, lam, w, class_chunk=2
+    )
+    model = est.fit(Dataset.of(X).shard(), Dataset.of(Y).shard())
+    W_ref, b_ref = ref_block_weighted_bcd(X, Y, block_size, num_iter, lam, w)
+    np.testing.assert_allclose(np.asarray(model.W), W_ref, atol=2e-2)
+    np.testing.assert_allclose(
+        np.asarray(model.intercept), b_ref, atol=2e-2
+    )
+
+
+def test_block_weighted_classifies(mesh8):
+    X, Y, y = _weighted_problem(n=120, D=8, C=3, seed=1)
+    est = BlockWeightedLeastSquaresEstimator(8, 2, 0.01, 0.5)
+    model = est.fit(Dataset.of(X), Dataset.of(Y))
+    pred = np.asarray(model.apply_batch(Dataset.of(X)).array())
+    assert (pred.argmax(1) == y).mean() > 0.95
+
+
+def test_block_weighted_weight():
+    assert BlockWeightedLeastSquaresEstimator(10, 3, 0.1, 0.5).weight == 10
+
+
+def test_per_class_weighted_close_to_block_weighted(mesh8):
+    """Both solvers optimize the same mixture-weighted objective; with
+    enough sweeps they land close on a well-conditioned problem."""
+    X, Y, y = _weighted_problem(n=100, D=6, C=2, seed=2)
+    lam, w = 0.05, 0.5
+    m1 = BlockWeightedLeastSquaresEstimator(6, 8, lam, w).fit(
+        Dataset.of(X), Dataset.of(Y)
+    )
+    m2 = PerClassWeightedLeastSquaresEstimator(6, 8, lam, w).fit(
+        Dataset.of(X), Dataset.of(Y)
+    )
+    p1 = np.asarray(m1.apply_batch(Dataset.of(X)).array())
+    p2 = np.asarray(m2.apply_batch(Dataset.of(X)).array())
+    assert (p1.argmax(1) == y).mean() > 0.95
+    assert (p2.argmax(1) == y).mean() > 0.95
+
+
+def test_per_class_weighted_shuffled_invariance(mesh8):
+    """Class-grouping must be order-independent (reference tests shuffled
+    CSV fixtures)."""
+    X, Y, _ = _weighted_problem(n=60, D=6, C=2, seed=3)
+    perm = np.random.default_rng(0).permutation(len(X))
+    est = BlockWeightedLeastSquaresEstimator(6, 1, 0.1, 0.5)
+    m1 = est.fit(Dataset.of(X), Dataset.of(Y))
+    m2 = est.fit(Dataset.of(X[perm]), Dataset.of(Y[perm]))
+    np.testing.assert_allclose(
+        np.asarray(m1.W), np.asarray(m2.W), atol=1e-3
+    )
