@@ -1,0 +1,46 @@
+"""CIFAR-10 binary loader.
+
+Reference: loaders/CifarLoader.scala:13 — parses the binary record format
+(1 label byte + 3·1024 channel-plane bytes per image) driver-locally then
+parallelizes. Images come out as (32, 32, 3) arrays indexed [x, y, c] with
+x = row.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.parallel.dataset import Dataset
+
+CIFAR_DIM = 32
+CIFAR_CHANNELS = 3
+RECORD_LEN = 1 + CIFAR_DIM * CIFAR_DIM * CIFAR_CHANNELS
+
+
+@dataclasses.dataclass
+class LabeledImages:
+    """(labels, images) pair — the CifarLoader output shape."""
+
+    labels: Dataset
+    images: Dataset
+
+
+def CifarLoader(path: str) -> LabeledImages:
+    raw = np.fromfile(path, dtype=np.uint8)
+    if raw.size % RECORD_LEN != 0:
+        raise ValueError(f"{path}: not a whole number of CIFAR records")
+    records = raw.reshape(-1, RECORD_LEN)
+    labels = records[:, 0].astype(np.int32)
+    imgs = (
+        records[:, 1:]
+        .reshape(-1, CIFAR_CHANNELS, CIFAR_DIM, CIFAR_DIM)
+        .transpose(0, 2, 3, 1)  # (n, x=row, y=col, c)
+        .astype(np.float32)
+    )
+    return LabeledImages(
+        labels=Dataset.from_array(jnp.asarray(labels)),
+        images=Dataset.from_array(jnp.asarray(imgs)),
+    )

@@ -1,0 +1,173 @@
+"""RandomPatchCifar — random-patch convolutional features + ZCA + pooling
++ block least squares.
+
+Reference: pipelines/images/cifar/RandomPatchCifar.scala:21 — sample random
+patches via Windower, normalize + ZCA-whiten them into a filter bank
+(computed eagerly at pipeline-construction time, :45-57), then
+Convolver -> SymmetricRectifier -> sum Pooler -> vectorize ->
+StandardScaler -> BlockLeastSquaresEstimator(4096, 1, λ) -> argmax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.loaders.cifar import CifarLoader, LabeledImages
+from keystone_tpu.ops.images import (
+    Convolver,
+    ImageVectorizer,
+    Pooler,
+    SymmetricRectifier,
+    Windower,
+)
+from keystone_tpu.ops.learning import (
+    BlockLeastSquaresEstimator,
+    ZCAWhitenerEstimator,
+)
+from keystone_tpu.ops.stats import Sampler, StandardScaler
+from keystone_tpu.ops.util.nodes import ClassLabelIndicators, MaxClassifier
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.workflow.api import Pipeline
+
+NUM_CLASSES = 10
+IMAGE_SIZE = 32
+NUM_CHANNELS = 3
+WHITENER_SAMPLE = 100_000
+
+
+@dataclasses.dataclass
+class RandomCifarConfig:
+    train_location: str = ""
+    test_location: str = ""
+    num_filters: int = 100
+    whitening_epsilon: float = 0.1
+    patch_size: int = 6
+    patch_steps: int = 1
+    pool_size: int = 14
+    pool_stride: int = 13
+    alpha: float = 0.25
+    lam: float = 0.0
+    seed: int = 0
+
+
+def _normalize_rows(mat: np.ndarray, alpha: float) -> np.ndarray:
+    """Stats.normalizeRows (reference: utils/Stats.scala:112-123)."""
+    means = np.nan_to_num(mat.mean(axis=1))
+    var = ((mat - means[:, None]) ** 2).sum(axis=1) / (mat.shape[1] - 1)
+    sds = np.sqrt(var + alpha)
+    sds = np.where(np.isnan(sds), np.sqrt(alpha), sds)
+    return (mat - means[:, None]) / sds[:, None]
+
+
+def build_filters(train_images: Dataset, conf: RandomCifarConfig):
+    """Sample patches, normalize, fit ZCA, emit whitened filter bank
+    (reference: RandomPatchCifar.scala:45-57)."""
+    patches = Windower(conf.patch_steps, conf.patch_size).apply(train_images)
+    vecs = ImageVectorizer().apply_batch(patches)
+    sample = Sampler(WHITENER_SAMPLE, seed=conf.seed).apply(vecs)
+    base = _normalize_rows(np.asarray(sample.array(), np.float64), 10.0)
+    whitener = ZCAWhitenerEstimator(eps=conf.whitening_epsilon).fit_single(
+        jnp.asarray(base, jnp.float32)
+    )
+    rng = np.random.default_rng(conf.seed)
+    idx = rng.choice(
+        base.shape[0], size=min(conf.num_filters, base.shape[0]),
+        replace=False,
+    )
+    unnorm = np.asarray(whitener.apply(jnp.asarray(base[idx], jnp.float32)))
+    norms = np.sqrt((unnorm**2).sum(axis=1))
+    filters = (unnorm / (norms[:, None] + 1e-10)) @ np.asarray(
+        whitener.whitener
+    ).T
+    return jnp.asarray(filters, jnp.float32), whitener
+
+
+def build_pipeline(
+    train: LabeledImages, conf: RandomCifarConfig
+) -> Pipeline:
+    filters, whitener = build_filters(train.images, conf)
+    labels = ClassLabelIndicators(NUM_CLASSES)(train.labels)
+    featurizer = (
+        Convolver(
+            filters, IMAGE_SIZE, IMAGE_SIZE, NUM_CHANNELS,
+            whitener=whitener, normalize_patches=True,
+        )
+        .and_then(SymmetricRectifier(alpha=conf.alpha))
+        .and_then(Pooler(conf.pool_stride, conf.pool_size))
+        .and_then(ImageVectorizer())
+    )
+    return (
+        featurizer.and_then(StandardScaler(), train.images)
+        .and_then(
+            BlockLeastSquaresEstimator(4096, num_iter=1, lam=conf.lam),
+            train.images,
+            labels,
+        )
+        .and_then(MaxClassifier())
+    )
+
+
+def run(train: LabeledImages, test: LabeledImages, conf: RandomCifarConfig):
+    pipeline = build_pipeline(train, conf)
+    evaluator = MulticlassClassifierEvaluator(NUM_CLASSES)
+    metrics = evaluator.evaluate(pipeline(test.images), test.labels)
+    return pipeline, metrics
+
+
+def synthetic_cifar(n_train=256, n_test=64, seed=0):
+    """Class-dependent color blobs standing in for CIFAR."""
+    rng = np.random.default_rng(seed)
+    means = rng.uniform(30, 220, size=(NUM_CLASSES, NUM_CHANNELS))
+
+    def make(n):
+        y = rng.integers(0, NUM_CLASSES, n)
+        imgs = (
+            means[y][:, None, None, :]
+            + rng.normal(0, 20, (n, IMAGE_SIZE, IMAGE_SIZE, NUM_CHANNELS))
+        ).clip(0, 255)
+        return LabeledImages(
+            labels=Dataset.from_array(jnp.asarray(y.astype(np.int32))),
+            images=Dataset.from_array(jnp.asarray(imgs.astype(np.float32))),
+        )
+
+    return make(n_train), make(n_test)
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description="RandomPatchCifar")
+    p.add_argument("--trainLocation", default="")
+    p.add_argument("--testLocation", default="")
+    p.add_argument("--numFilters", type=int, default=100)
+    p.add_argument("--whiteningEpsilon", type=float, default=0.1)
+    p.add_argument("--patchSize", type=int, default=6)
+    p.add_argument("--patchSteps", type=int, default=1)
+    p.add_argument("--poolSize", type=int, default=14)
+    p.add_argument("--poolStride", type=int, default=13)
+    p.add_argument("--alpha", type=float, default=0.25)
+    p.add_argument("--lambda", dest="lam", type=float, default=0.0)
+    a = p.parse_args(argv)
+    conf = RandomCifarConfig(
+        a.trainLocation, a.testLocation, a.numFilters, a.whiteningEpsilon,
+        a.patchSize, a.patchSteps, a.poolSize, a.poolStride, a.alpha, a.lam,
+    )
+    if conf.train_location:
+        train = CifarLoader(conf.train_location)
+        test = CifarLoader(conf.test_location)
+    else:
+        train, test = synthetic_cifar()
+    t0 = time.time()
+    _, metrics = run(train, test, conf)
+    print(metrics.summary())
+    print(f"Total time: {time.time() - t0:.2f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

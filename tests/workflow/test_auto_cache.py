@@ -1,0 +1,103 @@
+"""AutoCacheRule tests (reference: AutocCacheRuleSuite — cache-insertion
+decisions with fake profiles)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.util.cacher import Cacher
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.workflow.api import Pipeline, Transformer, transformer
+from keystone_tpu.workflow.auto_cache import (
+    AutoCacheRule,
+    Profile,
+    estimate_cached_runtime,
+    get_node_weights,
+    get_runs,
+    profile_nodes,
+)
+from keystone_tpu.workflow.graph import EMPTY_GRAPH, NodeId
+from keystone_tpu.workflow.operators import DatasetOperator, TransformerOperator
+
+
+class _CountingOp(TransformerOperator):
+    def __init__(self, weight=1):
+        self.weight = weight
+        self.calls = 0
+
+    def single_transform(self, inputs):
+        return inputs[0]
+
+    def batch_transform(self, inputs):
+        self.calls += 1
+        return inputs[0]
+
+    def eq_key(self):
+        return id(self)
+
+
+def _diamond_graph():
+    """data -> a -> (b, c) where b and c both consume a (a runs twice)."""
+    ds = Dataset.of(np.ones((8, 2), np.float32))
+    g, d = EMPTY_GRAPH.add_node(DatasetOperator(ds), ())
+    a_op = _CountingOp()
+    g, a = g.add_node(a_op, (d,))
+    g, b = g.add_node(_CountingOp(), (a,))
+    g, c = g.add_node(_CountingOp(weight=3), (a,))
+    g, s1 = g.add_sink(b)
+    g, s2 = g.add_sink(c)
+    return g, {"data": d, "a": a, "b": b, "c": c}
+
+
+def test_get_runs_counts_consumer_passes():
+    g, ids = _diamond_graph()
+    weights = get_node_weights(g)
+    runs = get_runs(g, set(), weights)
+    # a is consumed by b (weight 1) and c (weight 3) -> 4 evaluations
+    assert runs[ids["a"]] == 4
+    # caching a brings it to one evaluation for runtime purposes
+    rt_uncached = estimate_cached_runtime(
+        g, set(), {ids["a"]: Profile(100, 10, 0)}, weights
+    )
+    rt_cached = estimate_cached_runtime(
+        g, {ids["a"]}, {ids["a"]: Profile(100, 10, 0)}, weights
+    )
+    assert rt_uncached == 400 and rt_cached == 100
+
+
+def test_aggressive_cache_selects_multiply_used():
+    g, ids = _diamond_graph()
+    rule = AutoCacheRule("aggressive")
+    selected = rule.aggressive_cache(g, get_node_weights(g))
+    assert ids["a"] in selected
+    assert ids["b"] not in selected
+
+
+def test_greedy_respects_budget():
+    g, ids = _diamond_graph()
+    rule = AutoCacheRule("greedy", mem_budget_bytes=5)
+    profiles = {ids["a"]: Profile(100, 10, 0)}  # too big for budget
+    assert rule.greedy_cache(g, profiles, get_node_weights(g)) == set()
+    rule2 = AutoCacheRule("greedy", mem_budget_bytes=50)
+    assert rule2.greedy_cache(g, profiles, get_node_weights(g)) == {
+        ids["a"]
+    }
+
+
+def test_add_caches_inserts_cacher_between_node_and_children():
+    g, ids = _diamond_graph()
+    g2 = AutoCacheRule.add_caches(g, {ids["a"]})
+    cachers = [
+        n for n, op in g2.operators.items() if isinstance(op, Cacher)
+    ]
+    assert len(cachers) == 1
+    cacher = cachers[0]
+    assert g2.dependencies[cacher] == (ids["a"],)
+    assert g2.dependencies[ids["b"]] == (cacher,)
+    assert g2.dependencies[ids["c"]] == (cacher,)
+
+
+def test_profile_nodes_produces_estimates():
+    g, ids = _diamond_graph()
+    profiles = profile_nodes(g, sorted(g.operators))
+    assert ids["a"] in profiles
+    assert profiles[ids["a"]].ns >= 0
