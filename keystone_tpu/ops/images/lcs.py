@@ -1,0 +1,87 @@
+"""Local Color Statistics (LCS) extractor.
+
+Reference: nodes/images/LCSExtractor.scala:25 — per grid keypoint, the
+means and standard deviations of each RGB channel over a 4x4 neighborhood
+of sub-patches (96-dim descriptors); means/stds come from a centered box
+filter (ImageUtils.conv2D zero-pads floor((L-1)/2) low / rest high, so an
+even-length box is right-biased exactly as the reference's).
+
+TPU mapping: two depthwise box convolutions (sum and sum-of-squares) +
+one gather over the keypoint/neighborhood grid — all fused under jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.workflow.api import Transformer
+
+
+def _box_filter_same(img: jnp.ndarray, size: int) -> jnp.ndarray:
+    """(H, W, C) -> same-size box mean with the reference's asymmetric
+    zero padding (ImageUtils.conv2D:226-238)."""
+    pad_low = (size - 1) // 2
+    pad_high = size - 1 - pad_low
+    k = jnp.full((size,), 1.0 / size, jnp.float32)
+
+    def conv_axis(x, axis):
+        moved = jnp.moveaxis(x, axis, -1)
+        shape = moved.shape
+        flat = moved.reshape(-1, 1, shape[-1])
+        out = jax.lax.conv_general_dilated(
+            flat, k[None, None, :], (1,), [(pad_low, pad_high)],
+            dimension_numbers=("NCH", "OIH", "NCH"),
+        )
+        return jnp.moveaxis(out.reshape(shape), -1, axis)
+
+    return conv_axis(conv_axis(img, 0), 1)
+
+
+@dataclasses.dataclass(eq=False)
+class LCSExtractor(Transformer):
+    """Image (X, Y, C) -> (numLCSValues, numKeypoints) descriptor matrix,
+    column xKey·numPoolsY + yKey, row order: for each channel, for each
+    (nx, ny) neighbor: [mean, std] interleaved (LCSExtractor.scala:96-127).
+    """
+
+    stride: int
+    stride_start: int
+    sub_patch_size: int
+    vmap_batch = False
+
+    def apply(self, img):
+        return self._extract(jnp.asarray(img, jnp.float32))
+
+    @partial(jax.jit, static_argnums=(0,))
+    def _extract(self, img):
+        s = self.sub_patch_size
+        X, Y, C = img.shape
+        means = _box_filter_same(img, s)
+        sq = _box_filter_same(img * img, s)
+        stds = jnp.sqrt(jnp.maximum(sq - means * means, 0.0))
+
+        xs = jnp.arange(self.stride_start, X - self.stride_start, self.stride)
+        ys = jnp.arange(self.stride_start, Y - self.stride_start, self.stride)
+        # neighborhood offsets: -2s + s/2 - 1 .. s + s/2 - 1 step s
+        start = -2 * s + s // 2 - 1
+        end = s + s // 2 - 1
+        offs = jnp.arange(start, end + 1, s)
+
+        px = xs[:, None] + offs[None, :]  # (nx_keys, nb)
+        py = ys[:, None] + offs[None, :]  # (ny_keys, nb)
+        # gather (nx_keys, nb, ny_keys, nb, C)
+        m = means[px][:, :, py]
+        sd = stds[px][:, :, py]
+        # target layout rows: c, nx, ny -> interleaved mean/std;
+        # columns: xKey * numPoolsY + yKey
+        m = jnp.transpose(m, (4, 1, 3, 0, 2))  # (C, nbx, nby, xk, yk)
+        sd = jnp.transpose(sd, (4, 1, 3, 0, 2))
+        inter = jnp.stack([m, sd], axis=3)  # (C, nbx, nby, 2, xk, yk)
+        n_keys = xs.shape[0] * ys.shape[0]
+        return inter.reshape(-1, n_keys)

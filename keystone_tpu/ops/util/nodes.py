@@ -98,11 +98,12 @@ class TopKClassifier(Transformer):
     k: int
 
     def apply(self, scores):
-        _, idx = jax.lax.top_k(scores, self.k)
+        _, idx = jax.lax.top_k(scores, min(self.k, scores.shape[-1]))
         return idx
 
     def apply_batch(self, ds: Dataset) -> Dataset:
-        _, idx = jax.lax.top_k(ds.padded(), self.k)
+        x = ds.padded()
+        _, idx = jax.lax.top_k(x, min(self.k, x.shape[-1]))
         return Dataset.from_array(idx, n=ds.n)
 
 
