@@ -1,0 +1,127 @@
+"""Text/speech dataset loaders.
+
+Reference: loaders/NewsgroupsDataLoader.scala (per-class directories of
+plaintext files), loaders/AmazonReviewsDataLoader.scala (JSON reviews,
+rating threshold -> binary label), loaders/TimitFeaturesDataLoader.scala
+(CSV features + "row label" sparse label files, 440 dims / 147 classes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.loaders.csv_loader import LabeledData
+from keystone_tpu.parallel.dataset import Dataset
+
+NEWSGROUPS_CLASSES = [
+    "comp.graphics",
+    "comp.os.ms-windows.misc",
+    "comp.sys.ibm.pc.hardware",
+    "comp.sys.mac.hardware",
+    "comp.windows.x",
+    "rec.autos",
+    "rec.motorcycles",
+    "rec.sport.baseball",
+    "rec.sport.hockey",
+    "sci.crypt",
+    "sci.electronics",
+    "sci.med",
+    "sci.space",
+    "misc.forsale",
+    "talk.politics.misc",
+    "talk.politics.guns",
+    "talk.politics.mideast",
+    "talk.religion.misc",
+    "alt.atheism",
+    "soc.religion.christian",
+]
+
+TIMIT_DIMENSION = 440
+TIMIT_NUM_CLASSES = 147
+
+
+def NewsgroupsDataLoader(data_dir: str) -> LabeledData:
+    """train_or_test_dir/class_label/docs as separate plaintext files."""
+    labels: List[int] = []
+    texts: List[str] = []
+    for index, class_name in enumerate(NEWSGROUPS_CLASSES):
+        class_dir = os.path.join(data_dir, class_name)
+        if not os.path.isdir(class_dir):
+            continue
+        for fname in sorted(os.listdir(class_dir)):
+            path = os.path.join(class_dir, fname)
+            try:
+                with open(path, errors="replace") as f:
+                    texts.append(f.read())
+                labels.append(index)
+            except OSError:
+                continue
+    return LabeledData(
+        labels=Dataset.from_array(jnp.asarray(labels, jnp.int32)),
+        data=Dataset.from_items(texts),
+    )
+
+
+def AmazonReviewsDataLoader(path: str, threshold: float = 3.5) -> LabeledData:
+    """JSON-lines reviews with "overall" and "reviewText" fields; label 1
+    iff rating >= threshold."""
+    labels: List[int] = []
+    texts: List[str] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            labels.append(1 if float(row["overall"]) >= threshold else 0)
+            texts.append(row["reviewText"])
+    return LabeledData(
+        labels=Dataset.from_array(jnp.asarray(labels, jnp.int32)),
+        data=Dataset.from_items(texts),
+    )
+
+
+@dataclasses.dataclass
+class TimitFeaturesData:
+    train: LabeledData
+    test: LabeledData
+
+
+def _parse_sparse_labels(path: str) -> dict:
+    out = {}
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) >= 2:
+                out[int(parts[0]) - 1] = int(parts[1])
+    return out
+
+
+def TimitFeaturesDataLoader(
+    train_data_location: str,
+    train_labels_location: str,
+    test_data_location: str,
+    test_labels_location: str,
+) -> TimitFeaturesData:
+    def load(data_path, labels_path):
+        feats = np.loadtxt(data_path, delimiter=",", dtype=np.float32,
+                           ndmin=2)
+        label_map = _parse_sparse_labels(labels_path)
+        labels = np.asarray(
+            [label_map[i] - 1 for i in range(feats.shape[0])], np.int32
+        )
+        return LabeledData(
+            labels=Dataset.from_array(jnp.asarray(labels)),
+            data=Dataset.from_array(jnp.asarray(feats)),
+        )
+
+    return TimitFeaturesData(
+        train=load(train_data_location, train_labels_location),
+        test=load(test_data_location, test_labels_location),
+    )

@@ -1,0 +1,152 @@
+"""DAISY dense descriptors (Tola et al.).
+
+Reference: nodes/images/DaisyExtractor.scala:28 — oriented half-rectified
+gradient layers, cascaded Gaussian blurs per ring (sigma differences
+derived from daisyR/daisyQ), histogram sampling at ring points around
+each grid keypoint, per-histogram L2 normalization with a zero threshold.
+Output: (daisyFeatureSize, numKeypoints) matrix, matching the SIFT
+orientation convention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.ops.images.lcs import _box_filter_same  # asym-pad helper
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.workflow.api import Transformer
+
+
+def _conv2d_same(img2d: jnp.ndarray, kx: np.ndarray, ky: np.ndarray):
+    """Separable same-size conv with the reference's asymmetric zero
+    padding (ImageUtils.conv2D)."""
+
+    def conv_axis(x, k, axis):
+        pad_low = (len(k) - 1) // 2
+        pad_high = len(k) - 1 - pad_low
+        moved = jnp.moveaxis(x, axis, -1)
+        shape = moved.shape
+        flat = moved.reshape(-1, 1, shape[-1])
+        out = jax.lax.conv_general_dilated(
+            flat, jnp.asarray(k, jnp.float32)[None, None, :], (1,),
+            [(pad_low, pad_high)], dimension_numbers=("NCH", "OIH", "NCH"),
+        )
+        return jnp.moveaxis(out.reshape(shape), -1, axis)
+
+    return conv_axis(conv_axis(img2d, kx, 0), ky, 1)
+
+
+@dataclasses.dataclass(eq=False)
+class DaisyExtractor(Transformer):
+    daisy_t: int = 8  # angles per ring
+    daisy_q: int = 3  # rings
+    daisy_r: int = 7  # outer radius
+    daisy_h: int = 8  # orientation histograms
+    pixel_border: int = 16
+    stride: int = 4
+    patch_size: int = 24
+    feature_threshold: float = 1e-8
+    conv_threshold: float = 1e-6
+    vmap_batch = False
+
+    def __post_init__(self):
+        q, r = self.daisy_q, self.daisy_r
+        sigma_sq = [(r * n / (2 * q)) ** 2 for n in range(q + 1)]
+        self._sigma_sq_diff = [
+            b - a for a, b in zip(sigma_sq, sigma_sq[1:])
+        ]
+        self._g: List[np.ndarray] = []
+        for t in self._sigma_sq_diff:
+            half = int(
+                math.ceil(
+                    math.sqrt(
+                        -2 * t * math.log(self.conv_threshold)
+                        - t * math.log(2 * math.pi * t)
+                    )
+                )
+            )
+            ns = np.arange(-half, half + 1)
+            self._g.append(
+                np.exp(-(ns**2) / (2 * t)) / math.sqrt(2 * math.pi * t)
+            )
+
+    @property
+    def daisy_feature_size(self) -> int:
+        return self.daisy_h * (self.daisy_t * self.daisy_q + 1)
+
+    def apply(self, img):
+        x = jnp.asarray(img, jnp.float32)
+        if x.ndim == 3:
+            x = x[:, :, 0]
+        return self._extract(x)
+
+    @partial(jax.jit, static_argnums=(0,))
+    def _extract(self, img):
+        H, Q, T = self.daisy_h, self.daisy_q, self.daisy_t
+        ix = _conv2d_same(img, [1.0, 0.0, -1.0], [1.0, 2.0, 1.0])
+        iy = _conv2d_same(img, [1.0, 2.0, 1.0], [1.0, 0.0, -1.0])
+
+        # oriented half-rectified layers, cascade-blurred per ring
+        layers = []  # layers[level] : (H, X, Y)
+        level0 = []
+        for a in range(H):
+            angle = 2 * math.pi * a / H
+            plane = jnp.maximum(
+                math.cos(angle) * ix + math.sin(angle) * iy, 0.0
+            )
+            level0.append(_conv2d_same(plane, self._g[0], self._g[0]))
+        layers.append(jnp.stack(level0))
+        for level in range(1, Q):
+            layers.append(
+                jnp.stack(
+                    [
+                        _conv2d_same(
+                            layers[level - 1][a],
+                            self._g[level],
+                            self._g[level],
+                        )
+                        for a in range(H)
+                    ]
+                )
+            )
+
+        X, Y = img.shape
+        kx = np.arange(self.pixel_border, X - self.pixel_border, self.stride)
+        ky = np.arange(self.pixel_border, Y - self.pixel_border, self.stride)
+        n_keys = len(kx) * len(ky)
+        gx, gy = np.meshgrid(kx, ky, indexing="ij")  # (nx, ny)
+        gxf = jnp.asarray(gx.reshape(-1))
+        gyf = jnp.asarray(gy.reshape(-1))
+
+        def norm_hist(h):
+            # (n_keys, H) L2 normalize w/ zero threshold
+            nrm = jnp.linalg.norm(h, axis=1, keepdims=True)
+            return jnp.where(
+                nrm > self.feature_threshold, h / nrm, 0.0
+            )
+
+        out = jnp.zeros((n_keys, self.daisy_feature_size), jnp.float32)
+        center = norm_hist(
+            layers[0][:, gxf, gyf].T
+        )  # (n_keys, H)
+        out = out.at[:, :H].set(center)
+
+        for level in range(Q):
+            cur_rad = self.daisy_r * (1 + level) / Q
+            for a in range(T):
+                theta = 2 * math.pi * (a - 1) / T
+                ox = int(round(cur_rad * math.sin(theta)))
+                oy = int(round(cur_rad * math.cos(theta)))
+                h = layers[level][:, gxf + ox, gyf + oy].T
+                h = norm_hist(h)
+                col = H + a * Q * H + level * H
+                out = out.at[:, col : col + H].set(h)
+
+        return out.T  # (daisyFeatureSize, numKeypoints)

@@ -1,0 +1,36 @@
+from keystone_tpu.ops.nlp.string_utils import LowerCase, Tokenizer, Trim
+from keystone_tpu.ops.nlp.ngrams import (
+    NGram,
+    NGramsCounts,
+    NGramsFeaturizer,
+)
+from keystone_tpu.ops.nlp.hashing_tf import HashingTF, NGramsHashingTF
+from keystone_tpu.ops.nlp.word_frequency import (
+    WordFrequencyEncoder,
+    WordFrequencyTransformer,
+)
+from keystone_tpu.ops.nlp.stupid_backoff import (
+    NaiveBitPackIndexer,
+    NGramIndexer,
+    StupidBackoffEstimator,
+    StupidBackoffModel,
+    initial_bigram_partition,
+)
+
+__all__ = [
+    "HashingTF",
+    "LowerCase",
+    "NGram",
+    "NGramIndexer",
+    "NGramsCounts",
+    "NGramsFeaturizer",
+    "NGramsHashingTF",
+    "NaiveBitPackIndexer",
+    "StupidBackoffEstimator",
+    "StupidBackoffModel",
+    "Tokenizer",
+    "Trim",
+    "WordFrequencyEncoder",
+    "WordFrequencyTransformer",
+    "initial_bigram_partition",
+]

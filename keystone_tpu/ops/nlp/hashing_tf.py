@@ -1,0 +1,146 @@
+"""Hashing-trick term frequencies.
+
+Reference: nodes/nlp/HashingTF.scala:15 (Scala ``.##`` hash mod
+numFeatures -> SparseVector of counts) and NGramsHashingTF.scala:25
+(rolling MurmurHash3-style n-gram hashing that avoids materializing the
+ngram lists). Hashes here use a stable FNV-1a so results are reproducible
+across processes (Python's builtin hash is salted).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.workflow.api import Transformer
+
+_FNV_OFFSET = 0x811C9DC5
+_FNV_PRIME = 0x01000193
+_MASK = 0xFFFFFFFF
+
+
+def stable_hash(term: Any) -> int:
+    """FNV-1a over the utf-8 of str(term) — deterministic across runs."""
+    h = _FNV_OFFSET
+    for b in str(term).encode("utf-8"):
+        h = ((h ^ b) * _FNV_PRIME) & _MASK
+    return h
+
+
+def _to_sparse(counts: dict, num_features: int) -> jsparse.BCOO:
+    if counts:
+        idx = np.fromiter(counts.keys(), np.int32, len(counts))
+        order = np.argsort(idx)
+        indices = idx[order].reshape(-1, 1)
+        values = np.fromiter(
+            counts.values(), np.float32, len(counts)
+        )[order]
+    else:
+        indices = np.zeros((0, 1), np.int32)
+        values = np.zeros((0,), np.float32)
+    return jsparse.BCOO(
+        (jnp.asarray(values), jnp.asarray(indices)), shape=(num_features,)
+    )
+
+
+@dataclasses.dataclass(eq=False)
+class HashingTF(Transformer):
+    """term sequence -> sparse count vector (reference:
+    HashingTF.scala:15)."""
+
+    num_features: int
+    vmap_batch = False
+
+    def apply(self, document: Sequence) -> jsparse.BCOO:
+        counts: dict = {}
+        for term in document:
+            i = stable_hash(term) % self.num_features
+            counts[i] = counts.get(i, 0.0) + 1.0
+        return _to_sparse(counts, self.num_features)
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        rows, cols, vals = [], [], []
+        items = ds.items()
+        for r, doc in enumerate(items):
+            counts: dict = {}
+            for term in doc:
+                i = stable_hash(term) % self.num_features
+                counts[i] = counts.get(i, 0.0) + 1.0
+            for i, v in counts.items():
+                rows.append(r)
+                cols.append(i)
+                vals.append(v)
+        indices = np.stack(
+            [np.asarray(rows, np.int32), np.asarray(cols, np.int32)], axis=1
+        ) if rows else np.zeros((0, 2), np.int32)
+        mat = jsparse.BCOO(
+            (
+                jnp.asarray(np.asarray(vals, np.float32)),
+                jnp.asarray(indices),
+            ),
+            shape=(len(items), self.num_features),
+        )
+        return Dataset.from_array(mat, n=len(items))
+
+
+@dataclasses.dataclass(eq=False)
+class NGramsHashingTF(Transformer):
+    """Rolling-hash n-gram TF: hashes every ngram of the given consecutive
+    orders without materializing them (reference:
+    NGramsHashingTF.scala:25)."""
+
+    orders: Sequence[int]
+    num_features: int
+    vmap_batch = False
+
+    def __post_init__(self):
+        orders = list(self.orders)
+        for a, b in zip(orders, orders[1:]):
+            if b != a + 1:
+                raise ValueError(f"orders are not consecutive: {orders}")
+        self._lo = min(orders)
+        self._hi = max(orders)
+
+    def apply(self, tokens: Sequence) -> jsparse.BCOO:
+        counts: dict = {}
+        n = len(tokens)
+        token_hashes = [stable_hash(t) for t in tokens]
+        for i in range(n):
+            h = _FNV_OFFSET
+            for order in range(1, self._hi + 1):
+                if i + order > n:
+                    break
+                # roll the ngram hash forward one token
+                h = ((h ^ token_hashes[i + order - 1]) * _FNV_PRIME) & _MASK
+                if order >= self._lo:
+                    counts[h % self.num_features] = (
+                        counts.get(h % self.num_features, 0.0) + 1.0
+                    )
+        return _to_sparse(counts, self.num_features)
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        rows, cols, vals = [], [], []
+        items = ds.items()
+        for r, doc in enumerate(items):
+            vec = self.apply(doc)
+            idx = np.asarray(vec.indices).reshape(-1)
+            v = np.asarray(vec.data)
+            rows.extend([r] * len(idx))
+            cols.extend(idx.tolist())
+            vals.extend(v.tolist())
+        indices = np.stack(
+            [np.asarray(rows, np.int32), np.asarray(cols, np.int32)], axis=1
+        ) if rows else np.zeros((0, 2), np.int32)
+        mat = jsparse.BCOO(
+            (
+                jnp.asarray(np.asarray(vals, np.float32)),
+                jnp.asarray(indices),
+            ),
+            shape=(len(items), self.num_features),
+        )
+        return Dataset.from_array(mat, n=len(items))

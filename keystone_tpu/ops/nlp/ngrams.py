@@ -1,0 +1,84 @@
+"""N-gram extraction and counting.
+
+Reference: nodes/nlp/ngrams.scala — NGramsFeaturizer (consecutive orders,
+:20), NGram (hashable token-sequence key, :100), NGramsCounts
+(partition-local JHashMap counting + reduceByKey + descending sort, :152).
+The host-side Counter here is the shuffle-free equivalent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Any, List, Sequence, Tuple
+
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.workflow.api import FunctionNode, Transformer
+
+
+class NGram(tuple):
+    """Hashable n-gram key (reference: ngrams.scala:100 — a thin wrapper
+    with sane equals/hashCode; a tuple already has both)."""
+
+    @property
+    def words(self) -> Tuple:
+        return tuple(self)
+
+    def __repr__(self) -> str:
+        return f"[{','.join(str(w) for w in self)}]"
+
+
+@dataclasses.dataclass(eq=False)
+class NGramsFeaturizer(Transformer):
+    """token sequence -> all ngrams of the given consecutive orders
+    (reference: ngrams.scala:20-95; same emission order: for each start
+    position, min order first then extensions)."""
+
+    orders: Sequence[int]
+    vmap_batch = False
+
+    def __post_init__(self):
+        orders = list(self.orders)
+        if min(orders) < 1:
+            raise ValueError(f"minimum order is not >= 1: {min(orders)}")
+        for a, b in zip(orders, orders[1:]):
+            if b != a + 1:
+                raise ValueError(f"orders are not consecutive: {orders}")
+
+    def apply(self, tokens: Sequence) -> List[List]:
+        lo = min(self.orders)
+        hi = max(self.orders)
+        out: List[List] = []
+        n = len(tokens)
+        for i in range(n - lo + 1):
+            for order in range(lo, hi + 1):
+                if i + order > n:
+                    break
+                out.append(list(tokens[i : i + order]))
+        return out
+
+    def eq_key(self):
+        return ("ngrams_featurizer", tuple(self.orders))
+
+
+class NGramsCounts(FunctionNode):
+    """Dataset of per-line ngram lists -> (NGram, count) pairs sorted by
+    descending frequency (reference: ngrams.scala:152 — mode `default`
+    aggregates + sorts; `noAdd` keeps per-line partial counts)."""
+
+    def __init__(self, mode: str = "default"):
+        if mode not in ("default", "noAdd"):
+            raise ValueError("`mode` must be `default` or `noAdd`")
+        self.mode = mode
+
+    def apply(self, data) -> Dataset:
+        ds = Dataset.of(data)
+        counts: Counter = Counter()
+        for line in ds.items():
+            for gram in line:
+                counts[NGram(gram)] += 1
+        if self.mode == "default":
+            items = sorted(counts.items(), key=lambda kv: -kv[1])
+        else:
+            items = list(counts.items())
+        return Dataset.from_items(items)

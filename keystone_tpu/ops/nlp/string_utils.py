@@ -1,0 +1,47 @@
+"""String preprocessing transformers.
+
+Reference: nodes/nlp/StringUtils.scala:13,20,28 — regex tokenizer, trim,
+lowercase. Host-side ops over items-mode datasets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+from keystone_tpu.workflow.api import Transformer
+
+
+@dataclasses.dataclass(eq=False)
+class Tokenizer(Transformer):
+    """Split on a delimiting regex (default: punctuation + whitespace,
+    matching the reference's ``[\\p{Punct}\\s]+``)."""
+
+    sep: str = r"[^\w]+"
+    vmap_batch = False
+
+    def apply(self, s: str):
+        return [t for t in re.split(self.sep, s) if t]
+
+    def eq_key(self):
+        return ("tokenizer", self.sep)
+
+
+class Trim(Transformer):
+    vmap_batch = False
+
+    def apply(self, s: str) -> str:
+        return s.strip()
+
+    def eq_key(self):
+        return ("trim",)
+
+
+class LowerCase(Transformer):
+    vmap_batch = False
+
+    def apply(self, s: str) -> str:
+        return s.lower()
+
+    def eq_key(self):
+        return ("lower_case",)

@@ -1,0 +1,44 @@
+"""Frequency-rank word encoding.
+
+Reference: nodes/nlp/WordFrequencyEncoder.scala:7,43 — unigram counts
+sorted descending give each word its rank index; out-of-vocabulary maps
+to -1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Dict, Sequence
+
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.workflow.api import Estimator, Transformer
+
+OOV_INDEX = -1
+
+
+@dataclasses.dataclass(eq=False)
+class WordFrequencyTransformer(Transformer):
+    word_index: Dict[str, int]
+    unigram_counts: Dict[int, int]  # rank index -> count
+    vmap_batch = False
+
+    def apply(self, words: Sequence[str]):
+        return [self.word_index.get(w, OOV_INDEX) for w in words]
+
+    def eq_key(self):
+        return ("word_frequency_transformer", id(self.word_index))
+
+
+class WordFrequencyEncoder(Estimator):
+    def fit(self, data: Dataset) -> WordFrequencyTransformer:
+        counts: Counter = Counter()
+        for tokens in data.items():
+            counts.update(tokens)
+        ordered = sorted(counts.items(), key=lambda kv: -kv[1])
+        word_index = {w: i for i, (w, _) in enumerate(ordered)}
+        unigrams = {i: c for i, (_, c) in enumerate(ordered)}
+        return WordFrequencyTransformer(word_index, unigrams)
+
+    def eq_key(self):
+        return ("word_frequency_encoder", id(self))

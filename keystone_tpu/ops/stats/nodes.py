@@ -220,6 +220,8 @@ class TermFrequency(Transformer):
     def apply(self, terms):
         counts: dict = {}
         for t in terms:
+            if isinstance(t, list):  # ngram lists -> hashable tuples
+                t = tuple(t)
             counts[t] = counts.get(t, 0) + 1
         return {k: self.fn(v) for k, v in counts.items()}
 

@@ -30,6 +30,10 @@ from keystone_tpu.parallel import mesh as mesh_lib
 
 
 def _leading_dim(tree: Any) -> int:
+    # a BCOO (or any array-like) IS the array — don't descend into its
+    # pytree leaves (a BCOO's first leaf is the nse-length values array)
+    if hasattr(tree, "shape"):
+        return tree.shape[0]
     leaves = jax.tree_util.tree_leaves(tree)
     if not leaves:
         raise ValueError("empty pytree")

@@ -1,0 +1,111 @@
+"""TimitPipeline — random cosine features + block least squares for
+phone classification.
+
+Reference: pipelines/speech/TimitPipeline.scala:37-100 —
+gather(numCosines x CosineRandomFeatures(440 -> 4096, gaussian or cauchy))
+-> VectorCombiner -> BlockLeastSquaresEstimator(4096, numEpochs, lambda)
+-> MaxClassifier.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+from typing import Optional
+
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.loaders.csv_loader import LabeledData
+from keystone_tpu.loaders.text_loaders import (
+    TIMIT_DIMENSION,
+    TIMIT_NUM_CLASSES,
+    TimitFeaturesDataLoader,
+)
+from keystone_tpu.ops.learning import BlockLeastSquaresEstimator
+from keystone_tpu.ops.stats import CosineRandomFeatures
+from keystone_tpu.ops.util.nodes import (
+    ClassLabelIndicators,
+    MaxClassifier,
+    VectorCombiner,
+)
+from keystone_tpu.workflow.api import Pipeline
+
+NUM_COSINE_FEATURES = 4096
+
+
+@dataclasses.dataclass
+class TimitConfig:
+    train_data_location: str = ""
+    train_labels_location: str = ""
+    test_data_location: str = ""
+    test_labels_location: str = ""
+    num_cosines: int = 40
+    gamma: float = 0.05555
+    num_epochs: int = 5
+    lam: float = 0.0
+    rf_type: str = "gaussian"  # or "cauchy"
+    seed: int = 123
+    num_cosine_features: int = NUM_COSINE_FEATURES
+    dim: int = TIMIT_DIMENSION
+    num_classes: int = TIMIT_NUM_CLASSES
+
+
+def build_pipeline(train: LabeledData, conf: TimitConfig) -> Pipeline:
+    labels = ClassLabelIndicators(conf.num_classes)(train.labels)
+    branches = [
+        CosineRandomFeatures.create(
+            conf.dim,
+            conf.num_cosine_features,
+            conf.gamma,
+            seed=conf.seed + i,
+            distribution=conf.rf_type,
+        )
+        for i in range(conf.num_cosines)
+    ]
+    featurizer = Pipeline.gather(branches).and_then(VectorCombiner())
+    return featurizer.and_then(
+        BlockLeastSquaresEstimator(
+            conf.num_cosine_features, num_iter=conf.num_epochs, lam=conf.lam
+        ),
+        train.data,
+        labels,
+    ).and_then(MaxClassifier())
+
+
+def run(train: LabeledData, test: LabeledData, conf: TimitConfig):
+    predictor = build_pipeline(train, conf)
+    evaluator = MulticlassClassifierEvaluator(conf.num_classes)
+    metrics = evaluator.evaluate(predictor(test.data), test.labels)
+    return predictor, metrics
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description="TimitPipeline")
+    p.add_argument("--trainDataLocation", required=True)
+    p.add_argument("--trainLabelsLocation", required=True)
+    p.add_argument("--testDataLocation", required=True)
+    p.add_argument("--testLabelsLocation", required=True)
+    p.add_argument("--numCosines", type=int, default=40)
+    p.add_argument("--gamma", type=float, default=0.05555)
+    p.add_argument("--numEpochs", type=int, default=5)
+    p.add_argument("--lambda", dest="lam", type=float, default=0.0)
+    p.add_argument("--rfType", default="gaussian")
+    a = p.parse_args(argv)
+    conf = TimitConfig(
+        a.trainDataLocation, a.trainLabelsLocation, a.testDataLocation,
+        a.testLabelsLocation, a.numCosines, a.gamma, a.numEpochs, a.lam,
+        a.rfType,
+    )
+    data = TimitFeaturesDataLoader(
+        conf.train_data_location, conf.train_labels_location,
+        conf.test_data_location, conf.test_labels_location,
+    )
+    t0 = time.time()
+    _, metrics = run(data.train, data.test, conf)
+    print(metrics.summary())
+    print(f"Total time: {time.time() - t0:.2f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

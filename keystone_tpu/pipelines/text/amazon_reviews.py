@@ -1,0 +1,91 @@
+"""AmazonReviewsPipeline — n-gram logistic regression sentiment.
+
+Reference: pipelines/text/AmazonReviewsPipeline.scala:18-60 — Trim ->
+LowerCase -> Tokenizer -> NGramsFeaturizer(1..n) -> TermFrequency(x=>1) ->
+CommonSparseFeatures -> LogisticRegression(2 classes), evaluated with the
+binary evaluator.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from keystone_tpu.evaluation import BinaryClassifierEvaluator
+from keystone_tpu.loaders.csv_loader import LabeledData
+from keystone_tpu.loaders.text_loaders import AmazonReviewsDataLoader
+from keystone_tpu.ops.learning.classifiers import (
+    LogisticRegressionEstimator,
+)
+from keystone_tpu.ops.nlp import (
+    LowerCase,
+    NGramsFeaturizer,
+    Tokenizer,
+    Trim,
+)
+from keystone_tpu.ops.stats import TermFrequency
+from keystone_tpu.ops.util.nodes import CommonSparseFeatures
+from keystone_tpu.workflow.api import Pipeline
+
+
+@dataclasses.dataclass
+class AmazonReviewsConfig:
+    train_location: str = ""
+    test_location: str = ""
+    threshold: float = 3.5
+    n_grams: int = 2
+    common_features: int = 100_000
+    num_iters: int = 20
+
+
+def build_pipeline(train: LabeledData, conf: AmazonReviewsConfig) -> Pipeline:
+    featurizer = (
+        Trim()
+        .and_then(LowerCase())
+        .and_then(Tokenizer())
+        .and_then(NGramsFeaturizer(range(1, conf.n_grams + 1)))
+        .and_then(TermFrequency(lambda x: 1))
+    )
+    return featurizer.and_then(
+        CommonSparseFeatures(conf.common_features), train.data
+    ).and_then(
+        LogisticRegressionEstimator(2, num_iters=conf.num_iters),
+        train.data,
+        train.labels,
+    )
+
+
+def run(train: LabeledData, test: LabeledData, conf: AmazonReviewsConfig):
+    predictor = build_pipeline(train, conf)
+    pred = np.asarray(predictor(test.data).get().array())
+    metrics = BinaryClassifierEvaluator().evaluate(
+        pred > 0, np.asarray(test.labels.array()) > 0
+    )
+    return predictor, metrics
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description="AmazonReviewsPipeline")
+    p.add_argument("--trainLocation", required=True)
+    p.add_argument("--testLocation", required=True)
+    p.add_argument("--threshold", type=float, default=3.5)
+    p.add_argument("--nGrams", type=int, default=2)
+    p.add_argument("--commonFeatures", type=int, default=100_000)
+    p.add_argument("--numIters", type=int, default=20)
+    a = p.parse_args(argv)
+    conf = AmazonReviewsConfig(
+        a.trainLocation, a.testLocation, a.threshold, a.nGrams,
+        a.commonFeatures, a.numIters,
+    )
+    train = AmazonReviewsDataLoader(conf.train_location, conf.threshold)
+    test = AmazonReviewsDataLoader(conf.test_location, conf.threshold)
+    _, metrics = run(train, test, conf)
+    print(metrics.summary())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

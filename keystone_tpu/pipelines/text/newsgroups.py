@@ -1,0 +1,81 @@
+"""NewsgroupsPipeline — n-gram Naive Bayes text classification.
+
+Reference: pipelines/text/NewsgroupsPipeline.scala:18-45 — Trim ->
+LowerCase -> Tokenizer -> NGramsFeaturizer(1..n) -> TermFrequency(x=>1) ->
+CommonSparseFeatures(100k) -> NaiveBayes -> MaxClassifier.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional
+
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.loaders.csv_loader import LabeledData
+from keystone_tpu.loaders.text_loaders import (
+    NEWSGROUPS_CLASSES,
+    NewsgroupsDataLoader,
+)
+from keystone_tpu.ops.learning.classifiers import NaiveBayesEstimator
+from keystone_tpu.ops.nlp import (
+    LowerCase,
+    NGramsFeaturizer,
+    Tokenizer,
+    Trim,
+)
+from keystone_tpu.ops.stats import TermFrequency
+from keystone_tpu.ops.util.nodes import CommonSparseFeatures, MaxClassifier
+from keystone_tpu.workflow.api import Pipeline
+
+
+@dataclasses.dataclass
+class NewsgroupsConfig:
+    train_location: str = ""
+    test_location: str = ""
+    n_grams: int = 2
+    common_features: int = 100_000
+
+
+def build_pipeline(train: LabeledData, conf: NewsgroupsConfig) -> Pipeline:
+    num_classes = len(NEWSGROUPS_CLASSES)
+    featurizer = (
+        Trim()
+        .and_then(LowerCase())
+        .and_then(Tokenizer())
+        .and_then(NGramsFeaturizer(range(1, conf.n_grams + 1)))
+        .and_then(TermFrequency(lambda x: 1))
+    )
+    return featurizer.and_then(
+        CommonSparseFeatures(conf.common_features), train.data
+    ).and_then(
+        NaiveBayesEstimator(num_classes), train.data, train.labels
+    ).and_then(MaxClassifier())
+
+
+def run(train: LabeledData, test: LabeledData, conf: NewsgroupsConfig):
+    predictor = build_pipeline(train, conf)
+    evaluator = MulticlassClassifierEvaluator(len(NEWSGROUPS_CLASSES))
+    metrics = evaluator.evaluate(predictor(test.data), test.labels)
+    return predictor, metrics
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(description="NewsgroupsPipeline")
+    p.add_argument("--trainLocation", required=True)
+    p.add_argument("--testLocation", required=True)
+    p.add_argument("--nGrams", type=int, default=2)
+    p.add_argument("--commonFeatures", type=int, default=100_000)
+    a = p.parse_args(argv)
+    conf = NewsgroupsConfig(
+        a.trainLocation, a.testLocation, a.nGrams, a.commonFeatures
+    )
+    train = NewsgroupsDataLoader(conf.train_location)
+    test = NewsgroupsDataLoader(conf.test_location)
+    _, metrics = run(train, test, conf)
+    print(metrics.summary(NEWSGROUPS_CLASSES))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

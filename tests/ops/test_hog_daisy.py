@@ -1,0 +1,114 @@
+"""HOG/DAISY tests against loop translations of the reference."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from keystone_tpu.ops.images.daisy import DaisyExtractor
+from keystone_tpu.ops.images.hog import HogExtractor, UU, VV
+
+
+def _naive_hog_hist(img, b):
+    """Loop translation of HogExtractor.computeHist."""
+    X, Y, C = img.shape
+    nx, ny = round(X / b), round(Y / b)
+    hist = np.zeros((nx, ny, 18))
+    for x in range(1, nx * b - 1):
+        for y in range(1, ny * b - 1):
+            best_mag2, bdx, bdy = -np.inf, 0, 0
+            for c in range(C - 1, -1, -1):
+                dx = img[x + 1, y, c] - img[x - 1, y, c]
+                dy = img[x, y + 1, c] - img[x, y - 1, c]
+                m2 = dx * dx + dy * dy
+                if m2 > best_mag2:
+                    best_mag2, bdx, bdy = m2, dx, dy
+            mag = np.sqrt(best_mag2)
+            best_dot, best_o = 0.0, 0
+            for o in range(9):
+                dot = UU[o] * bdy + VV[o] * bdx
+                if dot > best_dot:
+                    best_o, best_dot = o, dot
+                elif -dot > best_dot:
+                    best_o, best_dot = o + 9, -dot
+            xp = (x + 0.5) / b - 0.5
+            yp = (y + 0.5) / b - 0.5
+            ixp, iyp = int(np.floor(xp)), int(np.floor(yp))
+            vx0, vy0 = xp - ixp, yp - iyp
+            for (cx, cy, w) in [
+                (ixp, iyp, (1 - vx0) * (1 - vy0)),
+                (ixp, iyp + 1, (1 - vx0) * vy0),
+                (ixp + 1, iyp, vx0 * (1 - vy0)),
+                (ixp + 1, iyp + 1, vx0 * vy0),
+            ]:
+                if 0 <= cx < nx and 0 <= cy < ny:
+                    hist[cx, cy, best_o] += w * mag
+    return hist
+
+
+def test_hog_features_shape_and_energy():
+    rng = np.random.default_rng(0)
+    img = rng.uniform(0, 255, (32, 32, 3)).astype(np.float32)
+    feats = np.asarray(HogExtractor(8).apply(img))
+    assert feats.shape == (4, 32)  # (4-2)^2 interior cells... nx=4 -> 2x2
+    assert feats.shape[0] == (4 - 2) ** 2
+    assert feats[:, :31].max() > 0
+    np.testing.assert_allclose(feats[:, 31], 0.0)  # truncation feature
+    # all normalized-clamped features within [0, 0.4]
+    assert feats[:, :18].max() <= 0.4 + 1e-6
+
+
+def test_hog_matches_naive_loop():
+    """Compare full extractor against the loop translation end-to-end
+    (via the histogram, then the same normalization math)."""
+    rng = np.random.default_rng(1)
+    img = rng.uniform(0, 255, (24, 24, 3)).astype(np.float32)
+    b = 8
+    hist_naive = _naive_hog_hist(img, b)
+    got = np.asarray(HogExtractor(b).apply(img))
+    # reproduce features from naive hist
+    nx = ny = 3
+    combined = hist_naive[:, :, :9] + hist_naive[:, :, 9:]
+    norm = (combined**2).sum(2)
+
+    def blk(x0, y0):
+        return (
+            norm[x0, y0] + norm[x0 + 1, y0] + norm[x0, y0 + 1]
+            + norm[x0 + 1, y0 + 1]
+        )
+
+    feats = np.zeros((1, 32))
+    n1 = 1 / np.sqrt(blk(1, 1) + 1e-4)
+    n2 = 1 / np.sqrt(blk(0, 1) + 1e-4)
+    n3 = 1 / np.sqrt(blk(1, 0) + 1e-4)
+    n4 = 1 / np.sqrt(blk(0, 0) + 1e-4)
+    h = hist_naive[1, 1]
+    hs = [np.minimum(h * n, 0.2) for n in (n1, n2, n3, n4)]
+    feats[0, :18] = 0.5 * sum(hs)
+    c = combined[1, 1]
+    cs = [np.minimum(c * n, 0.2) for n in (n1, n2, n3, n4)]
+    feats[0, 18:27] = 0.5 * sum(cs)
+    feats[0, 27:31] = 0.2357 * np.array([x.sum() for x in hs])
+    np.testing.assert_allclose(got, feats, atol=1e-4)
+
+
+def test_daisy_shapes_and_normalization():
+    rng = np.random.default_rng(2)
+    img = rng.uniform(0, 1, (48, 48)).astype(np.float32)
+    ext = DaisyExtractor()
+    out = np.asarray(ext.apply(img))
+    n_keys = len(range(16, 32, 4)) ** 2
+    assert out.shape == (ext.daisy_feature_size, n_keys)
+    # every H-sized histogram is L2-normalized (or zero)
+    H = ext.daisy_h
+    for i in range(0, ext.daisy_feature_size, H):
+        norms = np.linalg.norm(out[i : i + H, :], axis=0)
+        ok = (np.abs(norms - 1) < 1e-4) | (norms < 1e-6)
+        assert ok.all()
+
+
+def test_daisy_flat_image_zero():
+    img = np.full((48, 48), 0.5, np.float32)
+    out = np.asarray(DaisyExtractor().apply(img))
+    # constant image: gradients are zero away from borders; center
+    # histograms of interior keypoints are zero
+    assert np.abs(out).max() < 1.0

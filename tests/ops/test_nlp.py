@@ -1,0 +1,102 @@
+"""NLP node tests (reference: nodes/nlp suites — NGramsFeaturizerSuite,
+StupidBackoffSuite, indexers tests)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.nlp import (
+    HashingTF,
+    LowerCase,
+    NaiveBitPackIndexer,
+    NGram,
+    NGramIndexer,
+    NGramsCounts,
+    NGramsFeaturizer,
+    NGramsHashingTF,
+    StupidBackoffEstimator,
+    Tokenizer,
+    Trim,
+    WordFrequencyEncoder,
+)
+from keystone_tpu.parallel.dataset import Dataset
+
+
+def test_string_utils():
+    assert Trim().apply("  hi  ") == "hi"
+    assert LowerCase().apply("HeLLo") == "hello"
+    assert Tokenizer().apply("Hello, world! foo") == ["Hello", "world", "foo"]
+
+
+def test_ngrams_featurizer_orders_and_content():
+    grams = NGramsFeaturizer([1, 2, 3]).apply(["a", "b", "c"])
+    assert ["a"] in grams and ["a", "b"] in grams and ["a", "b", "c"] in grams
+    assert ["c"] in grams and ["b", "c"] in grams
+    assert len(grams) == 3 + 2 + 1
+    with pytest.raises(ValueError):
+        NGramsFeaturizer([1, 3])
+
+
+def test_ngrams_counts_sorted_desc():
+    lines = [[["a"], ["b"], ["a"]], [["a"], ["c"]]]
+    out = NGramsCounts().apply(Dataset.from_items(lines)).items()
+    assert out[0] == (NGram(("a",)), 3)
+    counts = dict(out)
+    assert counts[NGram(("b",))] == 1
+
+
+def test_hashing_tf_deterministic_counts():
+    tf = HashingTF(64)
+    v1 = tf.apply(["x", "y", "x"])
+    v2 = tf.apply(["x", "y", "x"])
+    a1, a2 = np.asarray(v1.todense()), np.asarray(v2.todense())
+    np.testing.assert_allclose(a1, a2)
+    assert a1.sum() == 3.0
+    assert a1.max() == 2.0
+
+
+def test_ngrams_hashing_tf_counts_all_orders():
+    tf = NGramsHashingTF([1, 2], 1024)
+    v = np.asarray(tf.apply(["a", "b", "c"]).todense())
+    # 3 unigrams + 2 bigrams
+    assert v.sum() == 5.0
+
+
+def test_word_frequency_encoder_ranks_and_oov():
+    data = Dataset.from_items(
+        [["the", "cat"], ["the", "dog"], ["the", "cat", "bird"]]
+    )
+    t = WordFrequencyEncoder().fit(data)
+    assert t.apply(["the"]) == [0]  # most frequent -> rank 0
+    assert t.apply(["cat"]) == [1]
+    assert t.apply(["unseen"]) == [-1]
+    assert t.unigram_counts[0] == 3
+
+
+def test_bitpack_indexer_roundtrip():
+    idx = NaiveBitPackIndexer()
+    tri = idx.pack([5, 9, 3])
+    assert idx.ngram_order(tri) == 3
+    assert [idx.unpack(tri, i) for i in range(3)] == [5, 9, 3]
+    bi = idx.remove_farthest_word(tri)
+    assert idx.ngram_order(bi) == 2
+    assert [idx.unpack(bi, i) for i in range(2)] == [9, 3]
+    ctx = idx.remove_current_word(tri)
+    assert idx.ngram_order(ctx) == 2
+    assert [idx.unpack(ctx, i) for i in range(2)] == [5, 9]
+
+
+def test_stupid_backoff_scores():
+    # corpus: "a b c", "a b d"
+    tokens = [["a", "b", "c"], ["a", "b", "d"]]
+    unigrams = {"a": 2, "b": 2, "c": 1, "d": 1}
+    grams = NGramsFeaturizer([2, 3]).apply_batch(Dataset.from_items(tokens))
+    counts = NGramsCounts().apply(grams)
+    model = StupidBackoffEstimator(unigrams).fit(counts)
+    # seen bigram: freq(a b)/freq(a) = 2/2
+    assert model.score(("a", "b")) == pytest.approx(1.0)
+    # seen trigram: freq(a b c)/freq(a b) = 1/2
+    assert model.score(("a", "b", "c")) == pytest.approx(0.5)
+    # unseen trigram backs off: alpha * S(b z) -> alpha^2 * freq(z)/N = 0
+    assert model.score(("a", "b", "z")) == pytest.approx(0.0)
+    # unseen bigram with seen tail: alpha * freq(b)/numTokens
+    assert model.score(("z", "b")) == pytest.approx(0.4 * 2 / 6)

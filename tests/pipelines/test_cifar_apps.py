@@ -1,0 +1,83 @@
+"""Remaining CIFAR app tests (reference: pipelines/images/cifar/*)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.pipelines.images.cifar_apps import (
+    RandomCifarAugmentedConfig,
+    RandomCifarKernelConfig,
+    linear_pixels,
+    random_cifar,
+    random_patch_cifar_augmented,
+    random_patch_cifar_kernel,
+)
+from keystone_tpu.pipelines.images.random_patch_cifar import synthetic_cifar
+
+
+def _spatial_cifar(n_train, n_test, seed=0):
+    """Class-dependent spatial gray patterns (plain color blobs collapse
+    to colliding scalars under GrayScaler, which no linear-in-gray model
+    can separate 10 ways)."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.loaders.cifar import LabeledImages
+    from keystone_tpu.parallel.dataset import Dataset
+
+    rng = np.random.default_rng(seed)
+    x, y = np.meshgrid(np.arange(32), np.arange(32))
+    patterns = [
+        100 + 80 * np.sin(2 * np.pi * (x * np.cos(a) + y * np.sin(a)) / p)
+        for a, p in zip(np.linspace(0, np.pi, 10, endpoint=False),
+                        [4, 6, 8, 10, 12, 5, 7, 9, 11, 13])
+    ]
+
+    def make(n):
+        ys = rng.integers(0, 10, n)
+        imgs = np.stack(
+            [patterns[c] + rng.normal(0, 10, (32, 32)) for c in ys]
+        )
+        imgs = np.repeat(imgs[:, :, :, None], 3, axis=3).clip(0, 255)
+        return LabeledImages(
+            labels=Dataset.from_array(jnp.asarray(ys.astype(np.int32))),
+            images=Dataset.from_array(
+                jnp.asarray(imgs.astype(np.float32))
+            ),
+        )
+
+    return make(n_train), make(n_test)
+
+
+def test_linear_pixels(mesh8):
+    # n must exceed the 1024 gray-pixel feature dim: the exact solver has
+    # no regularization (reference runs n=50000)
+    train, test = _spatial_cifar(n_train=2048, n_test=64, seed=0)
+    _, metrics = linear_pixels(train, test)
+    assert metrics.total_accuracy > 0.8
+
+
+def test_random_cifar(mesh8):
+    train, test = synthetic_cifar(n_train=96, n_test=24, seed=1)
+    _, metrics = random_cifar(
+        train, test, num_filters=12, pool_size=14, pool_stride=13, lam=100.0
+    )
+    assert metrics.total_accuracy > 0.3  # better than 0.1 chance
+
+
+def test_random_patch_cifar_kernel(mesh8):
+    train, test = synthetic_cifar(n_train=64, n_test=16, seed=2)
+    conf = RandomCifarKernelConfig(
+        num_filters=8, patch_size=6, patch_steps=4,
+        gamma=1e-2, block_size=32, num_epochs=3, lam=1.0,
+    )
+    _, metrics = random_patch_cifar_kernel(train, test, conf)
+    assert metrics.total_accuracy > 0.6
+
+
+def test_random_patch_cifar_augmented(mesh8):
+    train, test = synthetic_cifar(n_train=48, n_test=12, seed=3)
+    conf = RandomCifarAugmentedConfig(
+        num_filters=8, patch_size=6, patch_steps=4, lam=50.0,
+        augment_patch_size=24, augment_copies=3,
+    )
+    _, metrics = random_patch_cifar_augmented(train, test, conf)
+    assert 0.0 <= metrics.total_accuracy <= 1.0

@@ -1,0 +1,99 @@
+"""Text/speech/NLP pipeline tests (reference: pipelines/text/*,
+pipelines/speech/TimitPipeline.scala, pipelines/nlp/*)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.loaders.csv_loader import LabeledData
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.pipelines.nlp.stupid_backoff_pipeline import (
+    StupidBackoffConfig,
+)
+from keystone_tpu.pipelines.nlp.stupid_backoff_pipeline import run as sb_run
+from keystone_tpu.pipelines.speech.timit import TimitConfig
+from keystone_tpu.pipelines.speech.timit import run as timit_run
+from keystone_tpu.pipelines.text.amazon_reviews import (
+    AmazonReviewsConfig,
+)
+from keystone_tpu.pipelines.text.amazon_reviews import run as amazon_run
+from keystone_tpu.pipelines.text.newsgroups import NewsgroupsConfig
+from keystone_tpu.pipelines.text.newsgroups import run as news_run
+
+POS_WORDS = ["great", "love", "excellent", "awesome", "perfect"]
+NEG_WORDS = ["bad", "hate", "terrible", "awful", "poor"]
+
+
+def _sentiment_data(n=60, seed=0):
+    rng = np.random.default_rng(seed)
+    texts, labels = [], []
+    for _ in range(n):
+        pos = rng.random() < 0.5
+        words = rng.choice(POS_WORDS if pos else NEG_WORDS, 5)
+        texts.append(" ".join(words) + " product")
+        labels.append(1 if pos else 0)
+    import jax.numpy as jnp
+
+    return LabeledData(
+        labels=Dataset.from_array(jnp.asarray(labels, jnp.int32)),
+        data=Dataset.from_items(texts),
+    )
+
+
+def test_amazon_reviews_pipeline(mesh8):
+    train = _sentiment_data(80, seed=0)
+    test = _sentiment_data(20, seed=1)
+    conf = AmazonReviewsConfig(common_features=256, num_iters=30)
+    _, metrics = amazon_run(train, test, conf)
+    assert metrics.accuracy > 0.9
+
+
+def test_newsgroups_pipeline(mesh8):
+    # two synthetic "newsgroups" with disjoint vocab, mapped onto the
+    # first two class ids
+    rng = np.random.default_rng(2)
+    vocabs = [["compiler", "kernel", "gpu"], ["baseball", "pitcher", "inning"]]
+    texts, labels = [], []
+    for _ in range(60):
+        c = int(rng.random() < 0.5)
+        texts.append(" ".join(rng.choice(vocabs[c], 6)))
+        labels.append(c)
+    import jax.numpy as jnp
+
+    data = LabeledData(
+        labels=Dataset.from_array(jnp.asarray(labels, jnp.int32)),
+        data=Dataset.from_items(texts),
+    )
+    conf = NewsgroupsConfig(n_grams=2, common_features=128)
+    _, metrics = news_run(data, data, conf)
+    assert metrics.total_accuracy > 0.95
+
+
+def test_stupid_backoff_pipeline():
+    text = Dataset.from_items(
+        ["the cat sat", "the cat ran", "the dog sat"]
+    )
+    model, encoder = sb_run(text, StupidBackoffConfig(n=3))
+    the = encoder.word_index["the"]
+    cat = encoder.word_index["cat"]
+    score = model.score((the, cat))
+    assert score == pytest.approx(2 / 3)
+
+
+def test_timit_pipeline_tiny(mesh8):
+    rng = np.random.default_rng(3)
+    import jax.numpy as jnp
+
+    n, d, k = 200, 20, 5
+    centers = rng.standard_normal((k, d)) * 3
+    y = rng.integers(0, k, n)
+    X = (centers[y] + rng.standard_normal((n, d))).astype(np.float32)
+    train = LabeledData(
+        labels=Dataset.from_array(jnp.asarray(y, jnp.int32)),
+        data=Dataset.from_array(jnp.asarray(X)),
+    )
+    conf = TimitConfig(
+        num_cosines=2, gamma=0.1, num_epochs=2, lam=1e-3,
+        num_cosine_features=64, dim=d, num_classes=k,
+    )
+    _, metrics = timit_run(train, train, conf)
+    assert metrics.total_accuracy > 0.9
