@@ -1,0 +1,43 @@
+"""Single CLI entry: ``python -m keystone_tpu <AppName> [app args...]``.
+
+Reference: bin/run-pipeline.sh selects the pipeline class by fully
+qualified name as argv[1]; here short app names map to the app modules'
+``main``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+APPS = {
+    "MnistRandomFFT": "keystone_tpu.pipelines.images.mnist_random_fft",
+    "RandomPatchCifar": "keystone_tpu.pipelines.images.random_patch_cifar",
+    "ImageNetSiftLcsFV": "keystone_tpu.pipelines.images.imagenet_sift_lcs_fv",
+    "VOCSIFTFisher": "keystone_tpu.pipelines.images.voc_sift_fisher",
+    "TimitPipeline": "keystone_tpu.pipelines.speech.timit",
+    "NewsgroupsPipeline": "keystone_tpu.pipelines.text.newsgroups",
+    "AmazonReviewsPipeline": "keystone_tpu.pipelines.text.amazon_reviews",
+    "StupidBackoffPipeline": "keystone_tpu.pipelines.nlp.stupid_backoff_pipeline",
+}
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m keystone_tpu <AppName> [app args...]")
+        print("apps:")
+        for name in sorted(APPS):
+            print(f"  {name}")
+        return 0 if argv else 2
+    app = argv[0]
+    if app not in APPS:
+        print(f"unknown app {app!r}; run with --help for the list")
+        return 2
+    import importlib
+
+    module = importlib.import_module(APPS[app])
+    return module.main(argv[1:])
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -29,17 +29,13 @@ class LabeledImages:
 
 
 def CifarLoader(path: str) -> LabeledImages:
-    raw = np.fromfile(path, dtype=np.uint8)
-    if raw.size % RECORD_LEN != 0:
+    from keystone_tpu.native import read_cifar
+
+    import os
+
+    if os.path.getsize(path) % RECORD_LEN != 0:
         raise ValueError(f"{path}: not a whole number of CIFAR records")
-    records = raw.reshape(-1, RECORD_LEN)
-    labels = records[:, 0].astype(np.int32)
-    imgs = (
-        records[:, 1:]
-        .reshape(-1, CIFAR_CHANNELS, CIFAR_DIM, CIFAR_DIM)
-        .transpose(0, 2, 3, 1)  # (n, x=row, y=col, c)
-        .astype(np.float32)
-    )
+    labels, imgs = read_cifar(path, CIFAR_CHANNELS, CIFAR_DIM)
     return LabeledImages(
         labels=Dataset.from_array(jnp.asarray(labels)),
         images=Dataset.from_array(jnp.asarray(imgs)),

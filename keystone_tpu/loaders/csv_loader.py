@@ -17,8 +17,11 @@ from keystone_tpu.parallel.dataset import Dataset
 
 
 def CsvDataLoader(path: str, delimiter: str = ",") -> Dataset:
-    """Load a numeric CSV into one array-mode Dataset (n, d)."""
-    arr = np.loadtxt(path, delimiter=delimiter, dtype=np.float32, ndmin=2)
+    """Load a numeric CSV into one array-mode Dataset (n, d). Uses the
+    native multi-threaded parser when built (keystone_tpu/native.py)."""
+    from keystone_tpu.native import read_csv_f32
+
+    arr = read_csv_f32(path, delimiter=delimiter)
     return Dataset.from_array(jnp.asarray(arr))
 
 

@@ -1,0 +1,143 @@
+"""ctypes bindings for the native IO runtime (native/io.cc).
+
+The reference reaches native code via JNI (utils/external/VLFeat.scala,
+EncEval.scala); here the native layer serves the host input pipeline —
+multi-threaded CSV parsing and CIFAR record decoding — since the compute
+kernels are XLA programs. Falls back to numpy implementations when the
+shared library hasn't been built (``make -C native``); the first import
+attempts the build automatically.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libkeystone_io.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH) and os.path.exists(
+        os.path.join(_NATIVE_DIR, "Makefile")
+    ):
+        try:
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except Exception:
+            return None
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.csv_dims.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.csv_dims.restype = ctypes.c_int
+    lib.csv_read_f32.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int,
+    ]
+    lib.csv_read_f32.restype = ctypes.c_int
+    lib.cifar_read.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.cifar_read.restype = ctypes.c_int64
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def read_csv_f32(
+    path: str, delimiter: str = ",", num_threads: int = 0
+) -> np.ndarray:
+    """Numeric CSV -> (rows, cols) float32. Native multi-threaded parser
+    when available, np.loadtxt otherwise."""
+    lib = _load()
+    if lib is None or delimiter not in (",", " ", "\t"):
+        return np.loadtxt(
+            path, delimiter=delimiter, dtype=np.float32, ndmin=2
+        )
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    if lib.csv_dims(path.encode(), ctypes.byref(rows), ctypes.byref(cols)):
+        raise OSError(f"cannot read {path}")
+    out = np.empty((rows.value, cols.value), np.float32)
+    rc = lib.csv_read_f32(
+        path.encode(),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        rows.value,
+        cols.value,
+        num_threads,
+    )
+    if rc != 0:
+        # ragged or malformed — let numpy produce the proper error
+        return np.loadtxt(
+            path, delimiter=delimiter, dtype=np.float32, ndmin=2
+        )
+    return out
+
+
+def read_cifar(
+    path: str, channels: int = 3, dim: int = 32
+) -> Tuple[np.ndarray, np.ndarray]:
+    """CIFAR binary -> (labels int32 (n,), images float32 (n, dim, dim, c))."""
+    lib = _load()
+    rec_len = 1 + channels * dim * dim
+    size = os.path.getsize(path)
+    n = size // rec_len
+    if lib is None:
+        raw = np.fromfile(path, dtype=np.uint8)[: n * rec_len].reshape(
+            n, rec_len
+        )
+        labels = raw[:, 0].astype(np.int32)
+        images = (
+            raw[:, 1:]
+            .reshape(n, channels, dim, dim)
+            .transpose(0, 2, 3, 1)
+            .astype(np.float32)
+        )
+        return labels, images
+    labels = np.empty(n, np.int32)
+    images = np.empty((n, dim, dim, channels), np.float32)
+    got = lib.cifar_read(
+        path.encode(),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n,
+        channels,
+        dim,
+    )
+    if got < 0:
+        raise OSError(f"cannot read {path}")
+    return labels[:got], images[:got]

@@ -1,0 +1,84 @@
+"""Image utility functions.
+
+Reference: utils/images/ImageUtils.scala:16-399 — loadImage, toGrayScale,
+mapPixels, crop, pixelCombine, separable conv2D, splitChannels,
+flipImage/flipHorizontal; ImageConversions for decode. Images are
+``A[x, y, c]`` float arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.ops.images.core import GRAYSCALE_WEIGHTS
+from keystone_tpu.ops.images.daisy import _conv2d_same
+
+
+def load_image(path: str) -> Optional[jnp.ndarray]:
+    """Decode an image file to an (x, y, 3) float32 array (reference:
+    ImageUtils.loadImage via ImageIO)."""
+    from PIL import Image as PILImage
+
+    try:
+        img = PILImage.open(path).convert("RGB")
+    except Exception:
+        return None
+    return jnp.asarray(np.asarray(img, np.float32))
+
+
+def to_gray_scale(img: jnp.ndarray) -> jnp.ndarray:
+    """MATLAB rgb2gray weights (reference: ImageUtils.toGrayScale:73)."""
+    w = jnp.asarray(GRAYSCALE_WEIGHTS, jnp.float32)
+    return (img.astype(jnp.float32) @ w)[..., None]
+
+
+def map_pixels(img: jnp.ndarray, fn: Callable) -> jnp.ndarray:
+    return fn(img)
+
+
+def crop(img: jnp.ndarray, start_x: int, start_y: int, end_x: int,
+         end_y: int) -> jnp.ndarray:
+    return img[start_x:end_x, start_y:end_y]
+
+
+def pixel_combine(a: jnp.ndarray, b: jnp.ndarray,
+                  fn: Callable = jnp.add) -> jnp.ndarray:
+    return fn(a, b)
+
+
+def split_channels(img: jnp.ndarray) -> List[jnp.ndarray]:
+    return [img[:, :, c : c + 1] for c in range(img.shape[2])]
+
+
+def conv2d(img: jnp.ndarray, x_filter: Sequence[float],
+           y_filter: Sequence[float]) -> jnp.ndarray:
+    """Separable same-size convolution with the reference's asymmetric
+    zero padding (ImageUtils.conv2D:226)."""
+    squeeze = img.ndim == 3 and img.shape[2] == 1
+    x = img[:, :, 0] if squeeze else img
+    if x.ndim == 3:
+        out = jnp.stack(
+            [
+                _conv2d_same(x[:, :, c], np.asarray(x_filter),
+                             np.asarray(y_filter))
+                for c in range(x.shape[2])
+            ],
+            axis=2,
+        )
+        return out
+    out = _conv2d_same(x, np.asarray(x_filter), np.asarray(y_filter))
+    return out[:, :, None] if squeeze else out
+
+
+def flip_horizontal(img: jnp.ndarray) -> jnp.ndarray:
+    """Mirror along the y (column) axis."""
+    return img[:, ::-1]
+
+
+def flip_image(img: jnp.ndarray) -> jnp.ndarray:
+    """Flip both spatial axes (reference: ImageUtils.flipImage — used to
+    flip convolution filters for MATLAB convnd comparability)."""
+    return img[::-1, ::-1]

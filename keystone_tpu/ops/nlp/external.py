@@ -1,0 +1,89 @@
+"""Wrappers for external NLP annotators: POS tagging, NER, lemmatizing
+feature extraction.
+
+Reference: nodes/nlp/POSTagger.scala:24, NER.scala:20 (Epic CRF/SemiCRF
+models broadcast to executors), CoreNLPFeatureExtractor.scala:18 (sista
+processors tokenize/lemmatize/NER-replace + n-grams). Those JVM model
+libraries have no in-environment equivalent; these nodes accept any
+callable annotator (e.g. a spaCy pipeline or a transformers
+token-classification pipeline loaded from a local path) and otherwise
+raise with instructions — keeping the API surface while making the
+external-model dependency explicit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Optional, Sequence
+
+from keystone_tpu.ops.nlp.ngrams import NGramsFeaturizer
+from keystone_tpu.workflow.api import Transformer
+
+_MISSING = (
+    "{name} needs an external annotator model. Pass `annotator=` — any "
+    "callable mapping a token list to per-token labels (e.g. a local "
+    "spaCy or transformers token-classification pipeline)."
+)
+
+
+@dataclasses.dataclass(eq=False)
+class POSTagger(Transformer):
+    """tokens -> (token, tag) pairs via a pluggable annotator."""
+
+    annotator: Optional[Callable[[Sequence[str]], Sequence[str]]] = None
+    vmap_batch = False
+
+    def apply(self, tokens: Sequence[str]):
+        if self.annotator is None:
+            raise RuntimeError(_MISSING.format(name="POSTagger"))
+        tags = self.annotator(tokens)
+        return list(zip(tokens, tags))
+
+
+@dataclasses.dataclass(eq=False)
+class NER(Transformer):
+    """tokens -> per-token entity labels via a pluggable annotator."""
+
+    annotator: Optional[Callable[[Sequence[str]], Sequence[str]]] = None
+    vmap_batch = False
+
+    def apply(self, tokens: Sequence[str]):
+        if self.annotator is None:
+            raise RuntimeError(_MISSING.format(name="NER"))
+        return list(self.annotator(tokens))
+
+
+@dataclasses.dataclass(eq=False)
+class CoreNLPFeatureExtractor(Transformer):
+    """text -> n-grams over normalized tokens (reference:
+    CoreNLPFeatureExtractor.scala — tokenize, lemmatize, replace NER
+    entities with their types, then n-grams). Without an external
+    lemmatizer/NER this falls back to lowercase tokenization with a
+    light rule-based normalizer, keeping the pipeline shape."""
+
+    orders: Sequence[int] = (1, 2, 3)
+    lemmatizer: Optional[Callable[[str], str]] = None
+    ner: Optional[Callable[[Sequence[str]], Sequence[str]]] = None
+    vmap_batch = False
+
+    def _normalize(self, token: str) -> str:
+        t = token.lower()
+        if self.lemmatizer is not None:
+            return self.lemmatizer(t)
+        # light rule-based stemming fallback
+        for suffix in ("ing", "ed", "es", "s"):
+            if t.endswith(suffix) and len(t) > len(suffix) + 2:
+                return t[: -len(suffix)]
+        return t
+
+    def apply(self, text: str):
+        tokens = [t for t in re.split(r"[^\w]+", text) if t]
+        if self.ner is not None:
+            labels = self.ner(tokens)
+            tokens = [
+                lab if lab and lab != "O" else tok
+                for tok, lab in zip(tokens, labels)
+            ]
+        tokens = [self._normalize(t) for t in tokens]
+        return NGramsFeaturizer(self.orders).apply(tokens)

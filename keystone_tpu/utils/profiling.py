@@ -1,0 +1,82 @@
+"""Tracing / profiling utilities.
+
+Reference (SURVEY.md §5): the reference's tracing is (1) the AutoCacheRule
+sample profiler (workflow/auto_cache.py here), (2) ad-hoc per-phase timing
+logs (e.g. KernelRidgeRegression.scala:213-221), and (3) Graphviz DOT
+export of the DAG logged on every optimizer rule application.
+
+TPU equivalents here:
+- ``trace(dir)``: context manager around the JAX profiler — produces
+  XPlane traces viewable in TensorBoard/XProf (the substrate-level trace
+  the reference lacked).
+- ``PhaseTimer``: the per-phase wall-clock logger.
+- ``instrument_executor``: monkey-patches a GraphExecutor to record
+  per-node execution wall time (the interpret-layer profile).
+- DOT export lives on the Graph itself (``Graph.to_dot``), same as the
+  reference's toDOTString.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Dict, Iterator, Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """JAX profiler trace (XPlane) around a block of pipeline work."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class PhaseTimer:
+    """Accumulates named phase wall-clock times (reference: the
+    kernelGen/residual/collect/localSolve/modelUpdate logs in KRR)."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.times: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, phase_name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.times[phase_name] = self.times.get(phase_name, 0.0) + dt
+
+    def summary(self) -> str:
+        parts = [f"{k}: {v:.3f}s" for k, v in self.times.items()]
+        prefix = f"{self.name} " if self.name else ""
+        return prefix + " ".join(parts)
+
+    def log(self) -> None:
+        logger.info(self.summary())
+
+
+def instrument_executor(executor) -> Dict:
+    """Wraps a GraphExecutor's execute() to record per-node wall time.
+    Returns the (live) dict of node -> seconds."""
+    times: Dict = {}
+    original = executor.execute
+
+    def timed_execute(graph_id):
+        t0 = time.perf_counter()
+        out = original(graph_id)
+        times[graph_id] = times.get(graph_id, 0.0) + (
+            time.perf_counter() - t0
+        )
+        return out
+
+    executor.execute = timed_execute
+    return times

@@ -53,6 +53,66 @@ class PipelineEnv:
         self.state = {}
         self._optimizer = None
 
+    # -- persistence (SURVEY §5 checkpoint level 2: the prefix state is a
+    # content-addressed cache keyed by structural prefix hash; persisting
+    # it lets re-built pipelines in a NEW process skip recompute) --------
+
+    def save_state(self, path: str) -> None:
+        """Persist every materialized prefix expression. Unevaluated
+        (never-forced) expressions are skipped rather than forced."""
+        import pickle
+
+        import jax
+        import numpy as np
+
+        from keystone_tpu.parallel.dataset import Dataset
+
+        out = {}
+        for prefix, expr in self.state.items():
+            if not expr.is_computed:
+                continue
+            value = expr.get()
+            if isinstance(value, Dataset):
+                if value.is_array:
+                    arrs = jax.tree_util.tree_map(
+                        np.asarray, value.padded()
+                    )
+                    value = ("dataset_array", arrs, value.n)
+                else:
+                    value = ("dataset_items", value.items(), None)
+            else:
+                value = ("raw", value, None)
+            try:
+                pickle.dumps(value)
+            except Exception:
+                continue  # unpicklable (e.g. closure-defined transformer)
+            out[prefix] = value
+        with open(path, "wb") as f:
+            pickle.dump(out, f)
+
+    def load_state(self, path: str) -> int:
+        """Load persisted prefix state; returns the number of entries."""
+        import pickle
+
+        from keystone_tpu.parallel.dataset import Dataset
+        from keystone_tpu.workflow.expressions import (
+            DatasetExpression,
+            DatumExpression,
+        )
+
+        with open(path, "rb") as f:
+            saved = pickle.load(f)
+        for prefix, (kind, payload, n) in saved.items():
+            if kind == "dataset_array":
+                ds = Dataset.from_array(payload, n=n)
+                self.state[prefix] = DatasetExpression.of(ds)
+            elif kind == "dataset_items":
+                ds = Dataset.from_items(payload)
+                self.state[prefix] = DatasetExpression.of(ds)
+            else:
+                self.state[prefix] = DatumExpression.of(payload)
+        return len(saved)
+
 
 class GraphExecutor:
     """Executes a graph, memoizing per-id expressions.

@@ -1,0 +1,180 @@
+// keystone-tpu native IO runtime.
+//
+// The reference framework's runtime substrate is JVM/Spark with native
+// C++ kernels behind JNI (SURVEY.md §2.9); on TPU the compute kernels are
+// XLA programs, and the native layer moves to where it still pays: the
+// host input pipeline. This library provides the hot host-side paths —
+// numeric CSV parsing and CIFAR binary record decoding, both
+// multi-threaded — exposed over a C ABI consumed via ctypes
+// (keystone_tpu/native.py), with pure-Python fallbacks when the shared
+// library is absent.
+//
+// Build: `make -C native` (g++ -O3 -fPIC -shared -pthread).
+
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Counts rows and columns of a numeric CSV. Returns 0 on success.
+int csv_dims(const char* path, int64_t* rows, int64_t* cols);
+
+// Parses a numeric CSV into a preallocated rows*cols float32 buffer.
+// Multi-threaded over row chunks. Returns 0 on success.
+int csv_read_f32(const char* path, float* out, int64_t rows, int64_t cols,
+                 int num_threads);
+
+// Decodes CIFAR binary records: n records of (1 label byte + c*h*w
+// channel-plane bytes). labels: n int32; images: n*h*w*c float32 in
+// (row, col, channel) order. Returns number of records, or -1.
+int64_t cifar_read(const char* path, int32_t* labels, float* images,
+                   int64_t max_records, int channels, int dim);
+}
+
+namespace {
+
+struct FileBuf {
+  char* data = nullptr;
+  size_t size = 0;
+  ~FileBuf() { std::free(data); }
+  bool load(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return false;
+    std::fseek(f, 0, SEEK_END);
+    long n = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    data = static_cast<char*>(std::malloc(n + 1));
+    if (!data) {
+      std::fclose(f);
+      return false;
+    }
+    size = std::fread(data, 1, n, f);
+    data[size] = '\0';
+    std::fclose(f);
+    return true;
+  }
+};
+
+// Parses one line of comma/space-separated floats; returns count parsed.
+int64_t parse_line(const char* p, const char* end, float* out,
+                   int64_t max_vals) {
+  int64_t n = 0;
+  while (p < end && n < max_vals) {
+    while (p < end && (*p == ',' || *p == ' ' || *p == '\t')) ++p;
+    if (p >= end || *p == '\n' || *p == '\r') break;
+    char* next = nullptr;
+    out[n++] = std::strtof(p, &next);
+    if (next == p) break;
+    p = next;
+  }
+  return n;
+}
+
+}  // namespace
+
+int csv_dims(const char* path, int64_t* rows, int64_t* cols) {
+  FileBuf buf;
+  if (!buf.load(path)) return 1;
+  int64_t r = 0, c = 0;
+  const char* p = buf.data;
+  const char* end = buf.data + buf.size;
+  // columns from the first non-empty line
+  while (p < end && (*p == '\n' || *p == '\r')) ++p;
+  const char* line_end = static_cast<const char*>(
+      memchr(p, '\n', end - p));
+  if (!line_end) line_end = end;
+  for (const char* q = p; q < line_end; ++q) {
+    if (*q == ',') ++c;
+  }
+  if (line_end > p) ++c;
+  // count non-empty lines
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    if (!nl) nl = end;
+    for (const char* q = p; q < nl; ++q) {
+      if (!std::isspace(static_cast<unsigned char>(*q))) {
+        ++r;
+        break;
+      }
+    }
+    p = nl + 1;
+  }
+  *rows = r;
+  *cols = c;
+  return 0;
+}
+
+int csv_read_f32(const char* path, float* out, int64_t rows, int64_t cols,
+                 int num_threads) {
+  FileBuf buf;
+  if (!buf.load(path)) return 1;
+  // index line starts
+  std::vector<const char*> lines;
+  lines.reserve(rows);
+  const char* p = buf.data;
+  const char* end = buf.data + buf.size;
+  while (p < end && static_cast<int64_t>(lines.size()) < rows) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    if (!nl) nl = end;
+    for (const char* q = p; q < nl; ++q) {
+      if (!std::isspace(static_cast<unsigned char>(*q))) {
+        lines.push_back(p);
+        break;
+      }
+    }
+    p = nl + 1;
+  }
+  if (static_cast<int64_t>(lines.size()) != rows) return 2;
+
+  int nt = num_threads > 0 ? num_threads
+                           : std::thread::hardware_concurrency();
+  if (nt < 1) nt = 1;
+  std::vector<std::thread> workers;
+  std::vector<int> errors(nt, 0);
+  int64_t chunk = (rows + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    workers.emplace_back([&, t]() {
+      int64_t lo = t * chunk;
+      int64_t hi = std::min(rows, lo + chunk);
+      for (int64_t i = lo; i < hi; ++i) {
+        int64_t got = parse_line(lines[i], end, out + i * cols, cols);
+        if (got != cols) {
+          errors[t] = 1;
+          return;
+        }
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+  for (int e : errors)
+    if (e) return 3;
+  return 0;
+}
+
+int64_t cifar_read(const char* path, int32_t* labels, float* images,
+                   int64_t max_records, int channels, int dim) {
+  FileBuf buf;
+  if (!buf.load(path)) return -1;
+  const int64_t rec_len = 1 + channels * dim * dim;
+  int64_t n = buf.size / rec_len;
+  if (n > max_records) n = max_records;
+  const int64_t img_px = dim * dim;
+  for (int64_t i = 0; i < n; ++i) {
+    const unsigned char* rec =
+        reinterpret_cast<unsigned char*>(buf.data) + i * rec_len;
+    labels[i] = rec[0];
+    float* dst = images + i * img_px * channels;
+    for (int c = 0; c < channels; ++c) {
+      const unsigned char* plane = rec + 1 + c * img_px;
+      for (int64_t px = 0; px < img_px; ++px) {
+        dst[px * channels + c] = static_cast<float>(plane[px]);
+      }
+    }
+  }
+  return n;
+}

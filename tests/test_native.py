@@ -1,0 +1,37 @@
+"""Native IO library tests (vs the numpy fallbacks)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.native import native_available, read_cifar, read_csv_f32
+
+
+def test_native_csv_matches_numpy(tmp_path):
+    rng = np.random.default_rng(0)
+    arr = rng.standard_normal((50, 7)).astype(np.float32)
+    p = tmp_path / "data.csv"
+    np.savetxt(p, arr, delimiter=",")
+    got = read_csv_f32(str(p))
+    expect = np.loadtxt(p, delimiter=",", dtype=np.float32, ndmin=2)
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_native_cifar_roundtrip(tmp_path):
+    rng = np.random.default_rng(1)
+    n, dim, c = 5, 32, 3
+    labels = rng.integers(0, 10, n).astype(np.uint8)
+    planes = rng.integers(0, 256, (n, c, dim, dim)).astype(np.uint8)
+    records = np.concatenate(
+        [labels[:, None], planes.reshape(n, -1)], axis=1
+    )
+    p = tmp_path / "cifar.bin"
+    records.tofile(p)
+    got_labels, got_images = read_cifar(str(p), c, dim)
+    np.testing.assert_array_equal(got_labels, labels.astype(np.int32))
+    expect = planes.transpose(0, 2, 3, 1).astype(np.float32)
+    np.testing.assert_allclose(got_images, expect)
+
+
+def test_native_library_built():
+    # the shared library builds in this environment (g++ is baked in)
+    assert native_available()
