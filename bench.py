@@ -9,9 +9,11 @@ TPU chip(s). Features are generated on device (the baseline row times the
 solver, not featurization); stored bf16, Gram math accumulates f32 —
 the TPU-native precision discipline.
 
-Prints ONE JSON line:
+Prints one JSON line per metric:
   {"metric": ..., "value": ms, "unit": "ms", "vs_baseline": baseline/ours}
-vs_baseline > 1 means faster than the reference cluster.
+vs_baseline > 1 means faster than the reference cluster. The *_amortized
+metric isolates solver device-compute from the fixed ~100 ms round-trip
+of the tunneled single-chip setup (8 fits queued async, one sync).
 """
 
 from __future__ import annotations
@@ -64,12 +66,26 @@ def main() -> None:
         Yd = Dataset.from_array(Y, n=N)
 
         est = BlockLeastSquaresEstimator(block_size=BLOCK, num_iter=1, lam=0.1)
-        # warm-up compile on the same shapes
-        est.fit(Xd, Yd)
+        # warm-up compile on the same shapes; np.asarray forces real
+        # execution (block_until_ready alone doesn't drain the remote
+        # dispatch stream on tunneled devices)
+        np.asarray(est.fit(Xd, Yd).W)
         t0 = time.perf_counter()
         model = est.fit(Xd, Yd)
-        jax.block_until_ready(model.W)
+        np.asarray(model.W)
         elapsed_ms = (time.perf_counter() - t0) * 1000.0
+
+        # Amortized per-fit device time: the whole fit runs in the async
+        # dispatch stream with zero host syncs, so queueing R fits and
+        # syncing once isolates solver compute from the fixed ~100 ms
+        # host<->device round-trip of the tunneled single-chip setup.
+        reps = 8
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(reps):
+            last = est.fit(Xd, Yd)
+        np.asarray(last.W)
+        amortized_ms = (time.perf_counter() - t0) * 1000.0 / reps
 
     print(
         json.dumps(
@@ -78,6 +94,16 @@ def main() -> None:
                 "value": round(elapsed_ms, 1),
                 "unit": "ms",
                 "vs_baseline": round(BASELINE_MS / elapsed_ms, 2),
+            }
+        )
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "timit_block_ls_1024_solve_amortized",
+                "value": round(amortized_ms, 1),
+                "unit": "ms",
+                "vs_baseline": round(BASELINE_MS / amortized_ms, 2),
             }
         )
     )

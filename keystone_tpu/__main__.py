@@ -23,8 +23,23 @@ APPS = {
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if "--debug-optimizer" in argv:
+        # Per-rule optimizer trace: node-count deltas at INFO, full DOT
+        # graphs after each effective rule at DEBUG (reference logs DOT on
+        # every rule application, RuleExecutor.scala:44-50).
+        argv.remove("--debug-optimizer")
+        import logging
+
+        logging.basicConfig()
+        for mod in ("keystone_tpu.workflow.rules",
+                    "keystone_tpu.workflow.auto_cache",
+                    "keystone_tpu.workflow.node_optimization"):
+            logging.getLogger(mod).setLevel(logging.DEBUG)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: python -m keystone_tpu <AppName> [app args...]")
+        print(
+            "usage: python -m keystone_tpu [--debug-optimizer] "
+            "<AppName> [app args...]"
+        )
         print("apps:")
         for name in sorted(APPS):
             print(f"  {name}")

@@ -46,6 +46,56 @@ def _f32_mm(a, b):
     )
 
 
+def _psd_solve_device(gram, rhs, lam):
+    """(gram + lam·I) X = rhs on device, f32 Cholesky + two iterative-
+    refinement steps. Refinement recovers most of the f64 accuracy the
+    reference's driver-side LAPACK solve had (mlmatrix NormalEquations;
+    BlockLinearMapper.scala:234-240) without a host round-trip — through
+    a remote-dispatch link every host sync costs ~100 ms, so the solve
+    must stay inside the async dispatch stream. Falls back to
+    eigendecomposition with eigenvalue clamping when Cholesky breaks
+    down (indefiniteness from f32 rounding), mirroring hostsolve.py.
+    """
+    A = gram + lam * jnp.eye(gram.shape[0], dtype=gram.dtype)
+    L = jax.scipy.linalg.cholesky(A, lower=True)
+
+    def chol_path(L):
+        def solve(b):
+            return jax.scipy.linalg.cho_solve((L, True), b)
+
+        W = solve(rhs)
+        for _ in range(2):
+            W = W + solve(rhs - A @ W)
+        return W
+
+    def eigh_path(L):
+        del L
+        w, V = jnp.linalg.eigh(A)
+        w = jnp.maximum(w, 1e-12 * jnp.maximum(w[-1], 1.0))
+        return V @ ((V.T @ rhs) / w[:, None])
+
+    return jax.lax.cond(jnp.all(jnp.isfinite(L)), chol_path, eigh_path, L)
+
+
+@partial(
+    jax.jit, static_argnames=("width", "n"), donate_argnums=(1,)
+)
+def _block_step(X, R, Wb, mu_b, mask, start, lam, *, width: int, n: int):
+    """One whole BCD block update — stats, solve, and residual update —
+    as a single XLA program with no host synchronization. The reference's
+    executor-GEMM → treeReduce → driver-LAPACK → broadcast → residual
+    round trip (BlockLinearMapper.scala:234-240) becomes one dispatch.
+    """
+    Xb = jax.lax.dynamic_slice_in_dim(X, start, width, axis=1)
+    contrib = _f32_mm(Xb, Wb) - mask[:, None] * (mu_b @ Wb)
+    R_plus = R + contrib
+    gram = _f32_mm(Xb.T, Xb) - n * jnp.outer(mu_b, mu_b)
+    rhs = _f32_mm(Xb.T, R_plus) - jnp.outer(mu_b, jnp.sum(R_plus, axis=0))
+    Wb_new = _psd_solve_device(gram, rhs, lam)
+    contrib_new = _f32_mm(Xb, Wb_new) - mask[:, None] * (mu_b @ Wb_new)
+    return Wb_new, R_plus - contrib_new
+
+
 @partial(jax.jit, static_argnames=("width", "n"), donate_argnums=(1,))
 def _block_stats(X, R, Wb, mu_b, mask, start, *, width: int, n: int):
     """Per-block Gram pass on the RAW (possibly bf16) feature matrix.
@@ -154,6 +204,10 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     num_iter: int = 1
     lam: float = 0.0
     num_features: Optional[int] = None  # pad/truncate hint, parity only
+    solve: str = "device"  # "device" (f32 chol + refinement, zero host
+    # syncs — the fast path) | "host" (f64 LAPACK per block, for
+    # pathologically conditioned systems; costs a dispatch round-trip
+    # per block)
 
     def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
         # Mean-centering of features and labels (reference fits
@@ -181,15 +235,24 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         for _ in range(self.num_iter):
             for s, w in blocks:
                 mu_b = jax.lax.dynamic_slice_in_dim(mu, s, w)
-                gram, rhs, R_plus = _block_stats(
-                    X, R, Wb[s], mu_b, mask, s, width=w, n=n
-                )
-                # (b,b) solve on host in f64 (reference: driver-side
-                # NormalEquations solve) — see hostsolve.py.
-                Wb[s] = jnp.asarray(psd_solve_host(gram, rhs, self.lam))
-                R = _residual_update(
-                    X, R_plus, Wb[s], mu_b, mask, s, width=w
-                )
+                if self.solve == "device":
+                    # whole block update in one dispatch; the entire fit
+                    # stays in the async stream — no host sync until the
+                    # caller consumes W.
+                    Wb[s], R = _block_step(
+                        X, R, Wb[s], mu_b, mask, s, self.lam,
+                        width=w, n=n,
+                    )
+                else:
+                    gram, rhs, R_plus = _block_stats(
+                        X, R, Wb[s], mu_b, mask, s, width=w, n=n
+                    )
+                    # (b,b) solve on host in f64 (reference: driver-side
+                    # NormalEquations solve) — see hostsolve.py.
+                    Wb[s] = jnp.asarray(psd_solve_host(gram, rhs, self.lam))
+                    R = _residual_update(
+                        X, R_plus, Wb[s], mu_b, mask, s, width=w
+                    )
         W = jnp.concatenate([Wb[s] for s, _ in blocks], axis=0)
         return BlockLinearMapper(
             W,

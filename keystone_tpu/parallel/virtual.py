@@ -1,0 +1,81 @@
+"""Virtual-device provisioning for multi-chip code paths without chips.
+
+The reference tests simulate a cluster with multi-partition local RDDs
+(SURVEY.md §4); the JAX equivalent is a virtual n-device CPU platform.
+This is the ONE place that knows how to provision it — used by both
+tests/conftest.py and the driver's ``dryrun_multichip`` entry point so the
+two can't drift.
+
+JAX constraint: ``jax_platforms`` / ``jax_num_cpu_devices`` must be set
+before the backend initializes, and initializing is the only in-process
+way to count real devices. So when the backend is uninitialized we probe
+the real device count in a THROWAWAY SUBPROCESS and only downgrade the
+parent to the virtual CPU platform when the real platform is short.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_PROBE = "import jax; print(len(jax.devices()))"
+
+
+def backend_initialized() -> bool:
+    """Whether a jax backend already exists, WITHOUT creating one."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
+
+
+def _probe_real_device_count(timeout: float = 120.0) -> int:
+    """Count devices the default platform would give, in a subprocess so
+    the parent's backend stays uninitialized (and configurable)."""
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            env=env,
+        )
+        return int(out.stdout.strip().splitlines()[-1])
+    except Exception:
+        return 0
+
+
+def provision_devices(n_devices: int, *, probe_real: bool = True) -> None:
+    """Ensure ``jax.devices()`` will return >= n_devices.
+
+    Real devices are preferred: if the default platform already has enough
+    (probed in a subprocess when the backend is uninitialized), it is left
+    untouched. Otherwise the process is switched to a virtual CPU platform
+    with exactly ``n_devices`` devices. Raises if the backend is already
+    initialized with too few devices (too late to reconfigure).
+    """
+    import jax
+
+    if backend_initialized():
+        have = len(jax.devices())
+        if have < n_devices:
+            raise RuntimeError(
+                f"need {n_devices} devices but the jax backend is already "
+                f"initialized with {have}; call provision_devices() before "
+                f"any jax operation (fresh process)"
+            )
+        return
+
+    if probe_real and _probe_real_device_count() >= n_devices:
+        return  # real platform suffices; leave config alone
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n_devices)
+    have = len(jax.devices())
+    assert have >= n_devices, (
+        f"could not provision {n_devices} virtual CPU devices; got {have}"
+    )

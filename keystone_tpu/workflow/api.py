@@ -16,6 +16,7 @@ GatherTransformerOperator.scala, re-designed for JAX:
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import pickle
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
@@ -47,12 +48,22 @@ from keystone_tpu.workflow.operators import (
 from keystone_tpu.workflow.rules import UnusedBranchRemovalRule
 
 
+def _array_digest(a: np.ndarray) -> Any:
+    """Fixed-size fingerprint of an array's contents. CSE/prefix keys hold
+    this digest, never the raw bytes, so key size (and key comparison cost)
+    doesn't scale with parameter bytes."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(str(a.shape).encode())
+    h.update(str(a.dtype).encode())
+    h.update(np.ascontiguousarray(a).tobytes())
+    return ("arr", a.shape, str(a.dtype), h.hexdigest())
+
+
 def _hashable(v: Any) -> Any:
     if isinstance(v, np.ndarray):
-        return (v.shape, str(v.dtype), v.tobytes())
+        return _array_digest(v)
     if isinstance(v, jax.Array):
-        a = np.asarray(v)
-        return (a.shape, str(a.dtype), a.tobytes())
+        return _array_digest(np.asarray(v))
     if isinstance(v, (list, tuple)):
         return tuple(_hashable(x) for x in v)
     if isinstance(v, dict):
@@ -62,6 +73,47 @@ def _hashable(v: Any) -> Any:
         return v
     except TypeError:
         return id(v)
+
+
+def _cached_hashable(self, v: Any) -> Any:
+    """_hashable with the expensive array-digest step memoized per
+    (instance, array identity). Model arrays are replaced, never mutated
+    in place (jax.Arrays are immutable), so identity is a sound cache key;
+    cheap scalar fields are NOT cached, so post-construction mutation of
+    config fields still produces a fresh key."""
+    if isinstance(v, (np.ndarray, jax.Array)):
+        cache = self.__dict__.setdefault("_arr_digest_cache", {})
+        hit = cache.get(id(v))
+        if hit is None:
+            hit = _hashable(v)
+            cache[id(v)] = hit
+            # hold a reference so id() can't be recycled
+            cache[(id(v), "ref")] = v
+        return hit
+    if isinstance(v, (list, tuple)):
+        return tuple(_cached_hashable(self, x) for x in v)
+    if isinstance(v, dict):
+        return tuple(
+            sorted((k, _cached_hashable(self, x)) for k, x in v.items())
+        )
+    return _hashable(v)
+
+
+def _dataclass_eq_key(self) -> Any:
+    """Structural key for dataclass operators. The device->host transfer +
+    serialization of array fields happens at most once per distinct array
+    per operator no matter how often the optimizer recomputes prefixes/CSE
+    signatures (the reference relies on case-class equality, Scala-side
+    cheap; EquivalentNodeMergeRule.scala:13-15)."""
+    if not dataclasses.is_dataclass(self):
+        return id(self)
+    return (
+        type(self),
+        tuple(
+            (f.name, _cached_hashable(self, getattr(self, f.name)))
+            for f in dataclasses.fields(self)
+        ),
+    )
 
 
 class Chainable:
@@ -282,15 +334,7 @@ class Transformer(Chainable, TransformerOperator):
         return self.to_pipeline().apply(data)
 
     def eq_key(self) -> Any:
-        if dataclasses.is_dataclass(self):
-            return (
-                type(self),
-                tuple(
-                    (f.name, _hashable(getattr(self, f.name)))
-                    for f in dataclasses.fields(self)
-                ),
-            )
-        return id(self)
+        return _dataclass_eq_key(self)
 
     @property
     def label(self) -> str:  # type: ignore[override]
@@ -336,15 +380,7 @@ class Estimator(Chainable, EstimatorOperator):
         )
 
     def eq_key(self) -> Any:
-        if dataclasses.is_dataclass(self):
-            return (
-                type(self),
-                tuple(
-                    (f.name, _hashable(getattr(self, f.name)))
-                    for f in dataclasses.fields(self)
-                ),
-            )
-        return id(self)
+        return _dataclass_eq_key(self)
 
     @property
     def label(self) -> str:  # type: ignore[override]

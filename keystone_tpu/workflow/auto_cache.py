@@ -19,6 +19,7 @@ fraction of the accelerator's per-device memory.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import sys
 import time
 from typing import Dict, List, Optional, Set, Tuple
@@ -41,6 +42,8 @@ from keystone_tpu.workflow.graph import (
 )
 from keystone_tpu.workflow.operators import DatasetOperator, Operator
 from keystone_tpu.workflow.rules import PrefixMap, Rule
+
+logger = logging.getLogger(__name__)
 
 DEFAULT_SAMPLE_SCALES = (2, 4)  # reference: partitionScales = Seq(2, 4)
 DEFAULT_BUDGET_FRACTION = 0.75  # reference: 75% of remaining memory
@@ -312,7 +315,24 @@ class AutoCacheRule(Rule):
             to_cache = {n for n in to_cache if n in candidates}
         else:
             profiles = profile_nodes(graph, candidates, self.scales)
+            if logger.isEnabledFor(logging.INFO):
+                for n in sorted(profiles):
+                    p = profiles[n]
+                    logger.info(
+                        "auto-cache profile node %s [%s]: %.1f ms, "
+                        "%.0f device bytes, weight %d",
+                        n,
+                        graph.operators[n].label,
+                        p.ns / 1e6,
+                        p.device_mem,
+                        weights.get(n, 1),
+                    )
             to_cache = self.greedy_cache(graph, profiles, weights)
+        logger.info(
+            "auto-cache decision (%s): caching %s",
+            self.strategy,
+            sorted(to_cache) or "nothing",
+        )
         if not to_cache:
             return graph, prefixes
         return self.add_caches(graph, to_cache), prefixes

@@ -40,6 +40,14 @@ class Operator:
     def __hash__(self) -> int:
         return hash(self.eq_key())
 
+    def __getstate__(self):
+        # the eq_key digest cache holds array references keyed by id() —
+        # process-local state that must not bloat or poison pickles
+        # (FittedPipeline.save)
+        state = dict(self.__dict__)
+        state.pop("_arr_digest_cache", None)
+        return state
+
 
 class DatasetOperator(Operator):
     """Constant dataset (reference: DatasetOperator wrapping an RDD)."""

@@ -73,7 +73,29 @@ class RuleExecutor:
                 iteration += 1
                 before = (graph, dict(prefixes))
                 for rule in batch.rules:
+                    pre = graph
                     graph, prefixes = rule.apply(graph, prefixes)
+                    if logger.isEnabledFor(logging.INFO) and graph != pre:
+                        # Per-rule diff logging (reference:
+                        # RuleExecutor.scala:44-50 logs a DOT of the plan
+                        # after every effective rule application).
+                        logger.info(
+                            "optimizer batch %r rule %s (iter %d): "
+                            "%d -> %d nodes, %d -> %d sources",
+                            batch.name,
+                            rule.name,
+                            iteration,
+                            len(pre.operators),
+                            len(graph.operators),
+                            len(pre.sources),
+                            len(graph.sources),
+                        )
+                        if logger.isEnabledFor(logging.DEBUG):
+                            logger.debug(
+                                "graph after %s:\n%s",
+                                rule.name,
+                                graph.to_dot(),
+                            )
                 if graph == before[0] and prefixes == before[1]:
                     break
             else:

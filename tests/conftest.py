@@ -7,20 +7,15 @@ exercised without TPU hardware. Must be set before jax initializes.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-prev = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in prev:
-    os.environ["XLA_FLAGS"] = (
-        prev + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# jax may be preloaded at interpreter startup (axon platform plugin); the
-# env vars above are then too late — force the config directly before any
-# backend initialization.
-import jax  # noqa: E402
+from keystone_tpu.parallel.virtual import provision_devices  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# Tests always run on the virtual CPU mesh (fast, deterministic, no TPU
+# needed) — skip the real-device probe.
+provision_devices(8, probe_real=False)
 
 import pytest  # noqa: E402
 

@@ -118,3 +118,28 @@ def test_external_nlp_wrappers():
         NER().apply(["hello"])
     grams = CoreNLPFeatureExtractor(orders=[1]).apply("Dogs running fast")
     assert ["dog"] in grams or ["dogs"] in grams
+
+
+def test_optimizer_rule_trace_logging(caplog):
+    """Each effective rule application logs a node-count delta (reference:
+    RuleExecutor.scala:44-50 logs the plan after every rule)."""
+    import logging
+
+    from keystone_tpu.ops.stats import LinearRectifier, NormalizeRows
+    from keystone_tpu.parallel.dataset import Dataset
+
+    # two identical branches -> CSE has something to merge
+    a = LinearRectifier(0.0).and_then(NormalizeRows())
+    b = LinearRectifier(0.0).and_then(NormalizeRows())
+    from keystone_tpu.workflow.api import Pipeline
+
+    pipe = Pipeline.gather([a, b])
+    with caplog.at_level(logging.INFO, logger="keystone_tpu.workflow.rules"):
+        import numpy as np
+
+        pipe.apply(Dataset.from_array(np.ones((4, 3), np.float32))).get()
+    merges = [
+        r for r in caplog.records if "EquivalentNodeMergeRule" in r.message
+    ]
+    assert merges, "CSE merge should have been logged"
+    assert "-> " in merges[0].getMessage()

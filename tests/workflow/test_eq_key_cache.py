@@ -1,0 +1,88 @@
+"""eq_key must digest array fields once per instance and never embed raw
+bytes in the key (VERDICT r1 weak item 4: uncached, prefix/CSE cost scaled
+with total parameter bytes)."""
+
+import dataclasses
+
+import numpy as np
+
+from keystone_tpu.workflow import api
+from keystone_tpu.workflow.api import Transformer
+
+
+@dataclasses.dataclass(eq=False)
+class BigModel(Transformer):
+    W: np.ndarray
+
+    def apply(self, x):
+        return x @ self.W
+
+
+def test_array_digest_called_once_per_instance(monkeypatch):
+    calls = []
+    real = api._array_digest
+
+    def counting(a):
+        calls.append(a.nbytes)
+        return real(a)
+
+    monkeypatch.setattr(api, "_array_digest", counting)
+    t = BigModel(W=np.ones((512, 256), np.float32))
+    k1 = t.eq_key()
+    k2 = t.eq_key()
+    k3 = t.eq_key()
+    assert k1 == k2 == k3
+    assert len(calls) == 1  # one serialization ever
+
+
+def test_scalar_field_mutation_refreshes_key(monkeypatch):
+    """Only the array digest is cached — config-field mutation after
+    construction must still produce a fresh structural key."""
+
+    @dataclasses.dataclass(eq=False)
+    class WithScalar(Transformer):
+        W: np.ndarray
+        lam: float = 0.1
+
+        def apply(self, x):
+            return x
+
+    t = WithScalar(W=np.ones((4, 4), np.float32))
+    k1 = t.eq_key()
+    t.lam = 0.5
+    assert t.eq_key() != k1
+
+
+def test_digest_cache_not_pickled():
+    import pickle
+
+    t = BigModel(W=np.ones((64, 64), np.float32))
+    t.eq_key()
+    assert "_arr_digest_cache" in t.__dict__
+    t2 = pickle.loads(pickle.dumps(t))
+    assert "_arr_digest_cache" not in t2.__dict__
+    assert t2.eq_key() == t.eq_key()
+
+
+def test_key_is_digest_not_raw_bytes():
+    t = BigModel(W=np.zeros((1024, 1024), np.float32))  # 4 MB array
+    key = t.eq_key()
+
+    def total_size(obj):
+        if isinstance(obj, (tuple, list)):
+            return sum(total_size(x) for x in obj)
+        if isinstance(obj, (bytes, str)):
+            return len(obj)
+        return 8
+
+    assert total_size(key) < 4096  # fixed-size key, not 4 MB of bytes
+
+
+def test_equal_arrays_same_key_different_arrays_differ():
+    a = BigModel(W=np.arange(12, dtype=np.float32).reshape(3, 4))
+    b = BigModel(W=np.arange(12, dtype=np.float32).reshape(3, 4))
+    c = BigModel(W=np.arange(12, dtype=np.float32).reshape(3, 4) + 1)
+    assert a.eq_key() == b.eq_key()  # CSE still merges equal models
+    assert a.eq_key() != c.eq_key()
+    d = BigModel(W=np.arange(12, dtype=np.float32).reshape(4, 3))
+    assert a.eq_key() != d.eq_key()  # same bytes, different shape
