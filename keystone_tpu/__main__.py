@@ -50,6 +50,11 @@ def main(argv=None) -> int:
         return 2
     import importlib
 
+    # join the multi-host runtime when launched as one process per pod
+    # host (no-op on a single host; see parallel/runtime.py)
+    from keystone_tpu.parallel.runtime import initialize
+
+    initialize()
     module = importlib.import_module(APPS[app])
     return module.main(argv[1:])
 

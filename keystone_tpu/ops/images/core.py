@@ -237,7 +237,7 @@ class PixelScaler(Transformer):
             return Dataset.from_array(
                 ds.padded().astype(jnp.float32) / 255.0, n=ds.n
             )
-        return ds.map(self.apply)
+        return self._bucketed_batch(ds)
 
     def eq_key(self):
         return ("pixel_scaler",)
@@ -256,7 +256,7 @@ class GrayScaler(Transformer):
             w = jnp.asarray(GRAYSCALE_WEIGHTS, jnp.float32)
             out = (ds.padded().astype(jnp.float32) @ w)[..., None]
             return Dataset.from_array(out, n=ds.n)
-        return ds.map(self.apply)
+        return self._bucketed_batch(ds)
 
     def eq_key(self):
         return ("gray_scaler",)

@@ -54,7 +54,8 @@ class DaisyExtractor(Transformer):
     patch_size: int = 24
     feature_threshold: float = 1e-8
     conv_threshold: float = 1e-6
-    vmap_batch = False
+    vmap_batch = False  # ragged across shapes
+    bucket_vmap = True  # but vmappable within a shape bucket
 
     def __post_init__(self):
         q, r = self.daisy_q, self.daisy_r

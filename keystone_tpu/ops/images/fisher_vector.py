@@ -51,6 +51,22 @@ def _fisher_vector(fv_self, x):
     return jnp.concatenate([fv1, fv2], axis=1)  # (d, 2k)
 
 
+def _fv_from_stats(gmm, s0, s1, s2):
+    """Sanchez FV from the (already /m) statistics
+    (FisherVector.scala:42-52)."""
+    means, variances = gmm.means, gmm.variances  # (d, k)
+    weights = gmm.weights  # (k,)
+    fv1 = (s1 - means * s0[None, :]) / (
+        jnp.sqrt(variances) * jnp.sqrt(weights)[None, :]
+    )
+    fv2 = (
+        s2
+        - 2.0 * means * s1
+        + (means * means - variances) * s0[None, :]
+    ) / (variances * jnp.sqrt(2.0 * weights)[None, :])
+    return jnp.concatenate([fv1, fv2], axis=1)  # (d, 2k)
+
+
 @dataclasses.dataclass(eq=False)
 class FisherVector(Transformer):
     gmm: GaussianMixtureModel
@@ -67,6 +83,37 @@ class FisherVector(Transformer):
         return ds.map(self.apply)
 
 
+@dataclasses.dataclass(eq=False)
+class FisherVectorFused(Transformer):
+    """FV via the fused Pallas statistics kernel (the TPU equivalent of
+    the reference's enceval-native path, external/FisherVector.scala:17 →
+    EncEval.cxx:19): posterior computation and the three statistics
+    matmuls run in one kernel, never writing the (m, k) posterior matrix
+    to HBM — the win grows with k, hence the k >= 32 physical choice in
+    GMMFisherVectorEstimator."""
+
+    gmm: GaussianMixtureModel
+
+    def apply(self, x):
+        from keystone_tpu.ops.images.fv_pallas import (
+            fisher_vector_stats_pallas,
+        )
+
+        interpret = jax.default_backend() != "tpu"
+        g = self.gmm
+        s0, s1, s2 = fisher_vector_stats_pallas(
+            jnp.asarray(x, jnp.float32), g.means, g.variances, g.weights,
+            g.weight_threshold, interpret=interpret,
+        )
+        return _fv_from_stats(g, s0, s1, s2)
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        if ds.is_array:
+            out = jax.vmap(self.apply)(ds.padded().astype(jnp.float32))
+            return Dataset.from_array(out, n=ds.n)
+        return ds.map(self.apply)
+
+
 def _columns_of(data: Dataset):
     """Flatten (d, m) descriptor matrices into one (N, d) row matrix for
     GMM training (reference: flatMap(matrixToColArray))."""
@@ -78,9 +125,8 @@ def _columns_of(data: Dataset):
 
 @dataclasses.dataclass(eq=False)
 class ScalaGMMFisherVectorEstimator(Estimator):
-    """GMM-fit + FisherVector (reference: FisherVector.scala:65 — named
-    for parity; the implementation here is the same device code either
-    way)."""
+    """GMM-fit + unfused FisherVector (reference: FisherVector.scala:65
+    — the Scala implementation parallel)."""
 
     k: int
     seed: int = 0
@@ -92,26 +138,42 @@ class ScalaGMMFisherVectorEstimator(Estimator):
         return FisherVector(gmm)
 
 
-# the enceval-backed estimator of the reference
-# (nodes/images/external/FisherVector.scala:49) is the same computation on
-# TPU; keep the name for API parity
-EncEvalGMMFisherVectorEstimator = ScalaGMMFisherVectorEstimator
-
-
 @dataclasses.dataclass(eq=False)
-class GMMFisherVectorEstimator(Estimator, Optimizable):
-    """Optimizable wrapper (reference: FisherVector.scala:84-94 picks the
-    native implementation when k >= 32; both map to the same XLA program
-    here, so optimize() is the identity choice)."""
+class EncEvalGMMFisherVectorEstimator(Estimator):
+    """GMM-fit + fused-kernel FisherVector (reference:
+    external/FisherVector.scala:49 — the enceval-native parallel; here
+    the native path is the Pallas kernel in fv_pallas.py)."""
 
     k: int
     seed: int = 0
 
-    def fit(self, data: Dataset) -> FisherVector:
-        return ScalaGMMFisherVectorEstimator(self.k, self.seed).fit(data)
+    def fit(self, data: Dataset) -> FisherVectorFused:
+        gmm = GaussianMixtureModelEstimator(self.k, seed=self.seed).fit(
+            _columns_of(data)
+        )
+        return FisherVectorFused(gmm)
+
+
+@dataclasses.dataclass(eq=False)
+class GMMFisherVectorEstimator(Estimator, Optimizable):
+    """Optimizable physical choice (reference: FisherVector.scala:84-94
+    picks the native enceval implementation when k >= 32): large k favors
+    the fused Pallas kernel (posteriors stay in VMEM); small k favors the
+    plain XLA program (kernel launch overhead dominates)."""
+
+    k: int
+    seed: int = 0
+
+    def _choice(self) -> Estimator:
+        if self.k >= 32:
+            return EncEvalGMMFisherVectorEstimator(self.k, self.seed)
+        return ScalaGMMFisherVectorEstimator(self.k, self.seed)
+
+    def fit(self, data: Dataset) -> Transformer:
+        return self._choice().fit(data)
 
     def fit_datasets(self, datasets):
         return self.fit(datasets[0])
 
     def optimize(self, samples, n_total: int):
-        return ScalaGMMFisherVectorEstimator(self.k, self.seed)
+        return self._choice()

@@ -37,7 +37,8 @@ class HogExtractor(Transformer):
     """Image (X, Y, C) -> (numInteriorCells, 32) feature matrix."""
 
     bin_size: int
-    vmap_batch = False
+    vmap_batch = False  # ragged across shapes
+    bucket_vmap = True  # but vmappable within a shape bucket
 
     def apply(self, img):
         return self._extract(jnp.asarray(img, jnp.float32))

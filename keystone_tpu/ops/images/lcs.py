@@ -53,7 +53,8 @@ class LCSExtractor(Transformer):
     stride: int
     stride_start: int
     sub_patch_size: int
-    vmap_batch = False
+    vmap_batch = False  # ragged across shapes
+    bucket_vmap = True  # but vmappable within a shape bucket
 
     def apply(self, img):
         return self._extract(jnp.asarray(img, jnp.float32))

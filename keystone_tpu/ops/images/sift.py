@@ -22,7 +22,7 @@ NOTE: the reference's golden fixture (feats128.csv, ±1-of-99.5% vs MATLAB
 vl_phow) is not present in its repo, and vlfeat sources are not available
 in this environment, so bit-level parity against vlfeat cannot be
 asserted here; the algorithm is validated against an independent numpy
-translation of the same spec (tests/ops/test_sift.py).
+translation of the same spec (tests/ops/test_sift_fv.py).
 
 TPU mapping: everything is fused XLA — gradients, one-hot orientation
 scatter, two separable triangular convs (depthwise conv on the 8-plane
@@ -164,7 +164,8 @@ class SIFTExtractor(Transformer):
     bin: int = 4
     num_scales: int = 4
     scale_step: int = 0
-    vmap_batch = False
+    vmap_batch = False  # ragged across shapes
+    bucket_vmap = True  # but vmappable within a shape bucket
 
     def apply(self, img):
         x = jnp.asarray(img, jnp.float32)

@@ -58,6 +58,10 @@ def _psd_solve_device(gram, rhs, lam):
     """
     A = gram + lam * jnp.eye(gram.shape[0], dtype=gram.dtype)
     L = jax.scipy.linalg.cholesky(A, lower=True)
+    # full-f32 matmuls: refinement converges to the residual's noise
+    # floor, so the default bf16 matmul passes would cap the recovered
+    # accuracy ~3 digits short
+    hp = jax.lax.Precision.HIGHEST
 
     def chol_path(L):
         def solve(b):
@@ -65,14 +69,17 @@ def _psd_solve_device(gram, rhs, lam):
 
         W = solve(rhs)
         for _ in range(2):
-            W = W + solve(rhs - A @ W)
+            W = W + solve(rhs - jnp.matmul(A, W, precision=hp))
         return W
 
     def eigh_path(L):
         del L
         w, V = jnp.linalg.eigh(A)
         w = jnp.maximum(w, 1e-12 * jnp.maximum(w[-1], 1.0))
-        return V @ ((V.T @ rhs) / w[:, None])
+        return jnp.matmul(
+            V, jnp.matmul(V.T, rhs, precision=hp) / w[:, None],
+            precision=hp,
+        )
 
     return jax.lax.cond(jnp.all(jnp.isfinite(L)), chol_path, eigh_path, L)
 

@@ -49,9 +49,12 @@ class GaussianMixtureModel(Transformer):
         return self.means.shape[0]
 
     def _posteriors(self, X):
-        mu = self.means.T  # (k, d)
-        var = self.variances.T  # (k, d)
-        llh = _log_likelihoods(X, mu, var, self.weights)
+        # (d, k) operands consumed directly — transposing captured
+        # constants inside a fused jit program miscompiles on some TPU
+        # backends (observed: posteriors computed against wrong means)
+        llh = _log_likelihoods_dk(
+            X, self.means, self.variances, self.weights
+        )
         # shifted softmax (peak at 0) + aggressive thresholding
         llh = llh - jnp.max(llh, axis=1, keepdims=True)
         q = jnp.exp(llh)
@@ -81,22 +84,33 @@ class GaussianMixtureModel(Transformer):
 
 
 @jax.jit
-def _log_likelihoods(X, mu, var, weights):
+def _log_likelihoods_dk(X, mu_dk, var_dk, weights):
     """(n, k) log p(x, cluster): −½‖x−μ‖²_Λ − ½Σlog var + log w + const
-    (reference: GaussianMixtureModel.scala:47-66)."""
+    (reference: GaussianMixtureModel.scala:47-66). ``mu_dk``/``var_dk``
+    are (d, k) — the model's native layout; no transposes occur in the
+    program (see _posteriors for why)."""
     d = X.shape[1]
     xsq = X * X
+    # HIGHEST precision: TPU's default bf16 matmul passes lose ~3 decimal
+    # digits here, which the softmax amplifies into materially different
+    # posteriors (the reference computes these in f64 on CPU)
+    hp = jax.lax.Precision.HIGHEST
     sq_mahl = (
-        xsq @ (0.5 / var).T
-        - X @ (mu / var).T
-        + 0.5 * jnp.sum(mu * mu / var, axis=1)[None, :]
+        jnp.matmul(xsq, 0.5 / var_dk, precision=hp)
+        - jnp.matmul(X, mu_dk / var_dk, precision=hp)
+        + 0.5 * jnp.sum(mu_dk * mu_dk / var_dk, axis=0)[None, :]
     )
     return (
         -0.5 * d * jnp.log(2 * jnp.pi)
-        - 0.5 * jnp.sum(jnp.log(var), axis=1)[None, :]
+        - 0.5 * jnp.sum(jnp.log(var_dk), axis=0)[None, :]
         + jnp.log(weights)[None, :]
         - sq_mahl
     )
+
+
+def _log_likelihoods(X, mu, var, weights):
+    """Back-compat wrapper taking (k, d) mu/var."""
+    return _log_likelihoods_dk(X, mu.T, var.T, weights)
 
 
 @dataclasses.dataclass(eq=False)

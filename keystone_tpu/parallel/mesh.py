@@ -70,10 +70,20 @@ def set_mesh(mesh: Optional[Mesh]) -> None:
     _current_mesh = mesh
 
 
+def _example_axes(mesh: Mesh):
+    """Mesh axes the example dimension shards over: ("dcn", "data") on a
+    multi-slice mesh (DP spans slices; the per-slice Gram partials meet in
+    one small DCN all-reduce), plain "data" otherwise."""
+    if "dcn" in mesh.axis_names:
+        return ("dcn", DATA_AXIS)
+    return DATA_AXIS
+
+
 def data_sharding(mesh: Optional[Mesh] = None, ndim: int = 2) -> NamedSharding:
-    """Shard the leading (example) axis over DATA_AXIS; replicate the rest."""
+    """Shard the leading (example) axis over the data axes; replicate the
+    rest."""
     mesh = mesh or current_mesh()
-    spec = PartitionSpec(DATA_AXIS, *([None] * (ndim - 1)))
+    spec = PartitionSpec(_example_axes(mesh), *([None] * (ndim - 1)))
     return NamedSharding(mesh, spec)
 
 
@@ -84,4 +94,7 @@ def replicated_sharding(mesh: Optional[Mesh] = None) -> NamedSharding:
 
 def n_data_shards(mesh: Optional[Mesh] = None) -> int:
     mesh = mesh or current_mesh()
-    return mesh.shape[DATA_AXIS]
+    n = mesh.shape[DATA_AXIS]
+    if "dcn" in mesh.axis_names:
+        n *= mesh.shape["dcn"]
+    return n

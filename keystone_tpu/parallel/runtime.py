@@ -1,0 +1,164 @@
+"""Multi-host / multi-slice runtime.
+
+The reference's distributed substrate is a Spark cluster launched by
+``bin/run-pipeline.sh:9-55`` (spark-submit against $SPARK_HOME) and
+provisioned by ``bin/keystone-ec2.sh``. The TPU-native equivalent is a
+**SPMD process group**: one Python process per host, every process runs
+the same program, ``jax.distributed.initialize`` wires them into one
+runtime, and XLA collectives ride ICI within a slice and DCN across
+slices. There is no driver/executor split — the "driver-side solve"
+pattern of the reference becomes a replicated small computation.
+
+Axis layout (the scaling-book recipe):
+
+- ``dcn``   — the slice axis. Only data parallelism crosses it: per-slice
+  partial Gram/gradient sums are combined with one small all-reduce over
+  DCN, which is latency-tolerant.
+- ``data``  — intra-slice example sharding (ICI).
+- ``model`` — intra-slice feature/model-block sharding (ICI, bandwidth-
+  hungry collectives stay on ICI).
+
+Example pod launch (one command per host, e.g. via ``gcloud compute tpus
+tpu-vm ssh --worker=all``)::
+
+    python -m keystone_tpu TimitPipeline --trainLocation gs://... \
+        # jax.distributed auto-detects coordinator/process ids on TPU VMs
+
+On TPU VMs ``initialize()`` needs no arguments (cluster metadata supplies
+coordinator address / process count). On CPU/GPU clusters pass them
+explicitly or via env (COORDINATOR_ADDRESS, NUM_PROCESSES, PROCESS_ID).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from keystone_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+logger = logging.getLogger(__name__)
+
+DCN_AXIS = "dcn"
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> None:
+    """Join this process to the multi-host runtime (idempotent).
+
+    Wraps ``jax.distributed.initialize``. On Cloud TPU the three
+    arguments are auto-detected from instance metadata; elsewhere they
+    come from the arguments or the COORDINATOR_ADDRESS / NUM_PROCESSES /
+    PROCESS_ID environment variables (the launch script sets these, the
+    way run-pipeline.sh exported SPARK_HOME/KEYSTONE_MEM).
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and "NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and "PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        # single-process (or TPU-VM auto-detect) path
+        try:
+            jax.distributed.initialize()
+        except Exception as e:  # single-host dev runs have no cluster env
+            logger.info("jax.distributed not initialized (%s); single host", e)
+            _initialized = True
+            return
+    else:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+    _initialized = True
+    logger.info(
+        "distributed runtime up: process %d/%d, %d local / %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+
+
+def multislice_shape(
+    n_devices: int,
+    n_slices: Optional[int] = None,
+    n_model: int = 1,
+) -> Tuple[int, int, int]:
+    """Resolve the (dcn, data, model) mesh shape for ``n_devices``.
+
+    ``n_slices`` defaults to the number of distinct slices the platform
+    reports (1 when undetectable). ``n_model`` divides the per-slice
+    device count; the remainder is the intra-slice data axis.
+    """
+    if n_slices is None:
+        n_slices = _detect_num_slices()
+    if n_devices % n_slices:
+        raise ValueError(
+            f"{n_devices} devices not divisible into {n_slices} slices"
+        )
+    per_slice = n_devices // n_slices
+    if per_slice % n_model:
+        raise ValueError(
+            f"per-slice device count {per_slice} not divisible by "
+            f"model axis {n_model}"
+        )
+    return n_slices, per_slice // n_model, n_model
+
+
+def _detect_num_slices(devices: Optional[Sequence[jax.Device]] = None) -> int:
+    devs = list(devices) if devices is not None else jax.devices()
+    slice_ids = {getattr(d, "slice_index", 0) for d in devs}
+    return max(len(slice_ids), 1)
+
+
+def make_multislice_mesh(
+    n_slices: Optional[int] = None,
+    n_model: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (dcn, data, model) mesh.
+
+    Devices are grouped so that the ``dcn`` axis exactly follows slice
+    boundaries (each mesh row is one slice's devices) — DCN-crossing
+    collectives then appear only on the ``dcn`` axis. Solvers that psum
+    over the example axis shard data over ``("dcn", "data")`` jointly
+    (mesh.data_sharding handles this), which XLA lowers to an
+    ICI reduce(-scatter) per slice plus one small DCN all-reduce of the
+    (b, b)-shaped partials — the treeReduce topology of the reference
+    (MLMatrixUtils.treeReduce) realized in hardware.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    n_slices_, n_data, n_model_ = multislice_shape(
+        len(devs), n_slices if n_slices is not None
+        else _detect_num_slices(devs),
+        n_model,
+    )
+    # stable grouping: sort by (slice, process, id) so each dcn row is one
+    # physical slice when slice metadata exists
+    devs.sort(
+        key=lambda d: (
+            getattr(d, "slice_index", 0),
+            getattr(d, "process_index", 0),
+            d.id,
+        )
+    )
+    arr = np.array(devs).reshape(n_slices_, n_data, n_model_)
+    return Mesh(arr, (DCN_AXIS, DATA_AXIS, MODEL_AXIS))

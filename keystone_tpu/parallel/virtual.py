@@ -33,16 +33,16 @@ def backend_initialized() -> bool:
 
 
 def _probe_real_device_count(timeout: float = 120.0) -> int:
-    """Count devices the default platform would give, in a subprocess so
-    the parent's backend stays uninitialized (and configurable)."""
-    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    """Count devices the parent process would get, in a subprocess so the
+    parent's backend stays uninitialized (and configurable). The probe
+    inherits the environment unchanged — a user-forced JAX_PLATFORMS must
+    be counted the same way the parent will experience it."""
     try:
         out = subprocess.run(
             [sys.executable, "-c", _PROBE],
             capture_output=True,
             text=True,
             timeout=timeout,
-            env=env,
         )
         return int(out.stdout.strip().splitlines()[-1])
     except Exception:

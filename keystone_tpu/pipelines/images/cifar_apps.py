@@ -206,3 +206,77 @@ def random_patch_cifar_augmented(
         list(names), NUM_CLASSES
     ).evaluate(scores, test_labels_aug)
     return pipeline, metrics
+
+
+@dataclasses.dataclass
+class RandomCifarAugmentedKernelConfig(RandomCifarAugmentedConfig):
+    gamma: float = 2e-4
+    block_size: int = 512
+    num_epochs: int = 1
+    flip_chance: float = 0.5
+
+
+def random_patch_cifar_augmented_kernel(
+    train: LabeledImages,
+    test: LabeledImages,
+    conf: RandomCifarAugmentedKernelConfig,
+):
+    """Augmented CIFAR featurization solved by Gauss-Seidel kernel ridge
+    regression; train crops get an extra random horizontal flip, test
+    copies are merged by the augmented evaluator (reference:
+    RandomPatchCifarAugmentedKernel.scala:33-120)."""
+    from keystone_tpu.ops.images import RandomImageTransformer
+
+    aug_size = conf.augment_patch_size
+    patcher = RandomPatcher(
+        conf.augment_copies, aug_size, aug_size, seed=conf.seed
+    )
+    flipper = RandomImageTransformer(
+        flip_chance=conf.flip_chance, seed=conf.seed + 1
+    )
+    aug_images = flipper.apply_batch(patcher.apply_batch(train.images))
+    # LabelAugmenter equivalent: each source label repeated per crop
+    aug_labels_int = np.repeat(
+        np.asarray(train.labels.array()), conf.augment_copies
+    )
+    aug_labels = ClassLabelIndicators(NUM_CLASSES)(
+        Dataset.from_array(jnp.asarray(aug_labels_int))
+    )
+
+    filters, whitener = build_filters(aug_images, conf)
+    pipeline = (
+        Convolver(
+            filters, aug_size, aug_size, NUM_CHANNELS,
+            whitener=whitener, normalize_patches=True,
+        )
+        .and_then(SymmetricRectifier(alpha=conf.alpha))
+        .and_then(Pooler(conf.pool_stride, conf.pool_size))
+        .and_then(ImageVectorizer())
+        .and_then(Cacher())
+        .and_then(StandardScaler(), aug_images)
+        .and_then(
+            KernelRidgeRegression(
+                GaussianKernelGenerator(conf.gamma),
+                conf.lam,
+                conf.block_size,
+                conf.num_epochs,
+                block_permuter=conf.seed,
+            ),
+            aug_images,
+            aug_labels,
+        )
+    )
+
+    test_patcher = CenterCornerPatcher(
+        aug_size, aug_size, horizontal_flips=True
+    )
+    test_aug = test_patcher.apply_batch(test.images)
+    per_image = test_patcher.patches_per_image  # 10: 5 crops x flips
+    names = np.repeat(np.arange(test.images.n), per_image)
+    test_labels_aug = np.repeat(np.asarray(test.labels.array()), per_image)
+
+    scores = pipeline(test_aug).get()
+    metrics = AugmentedExamplesEvaluator(
+        list(names), NUM_CLASSES
+    ).evaluate(scores, test_labels_aug)
+    return pipeline, metrics

@@ -21,6 +21,7 @@ import pickle
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from keystone_tpu.parallel.dataset import Dataset
@@ -77,11 +78,15 @@ def _hashable(v: Any) -> Any:
 
 def _cached_hashable(self, v: Any) -> Any:
     """_hashable with the expensive array-digest step memoized per
-    (instance, array identity). Model arrays are replaced, never mutated
-    in place (jax.Arrays are immutable), so identity is a sound cache key;
-    cheap scalar fields are NOT cached, so post-construction mutation of
-    config fields still produces a fresh key."""
-    if isinstance(v, (np.ndarray, jax.Array)):
+    (instance, array identity) — but ONLY for immutable arrays
+    (jax.Array, or np.ndarray with writeable=False): identity is a sound
+    cache key only when the bytes can't change underneath it. Mutable
+    np.ndarrays and cheap scalar fields are digested fresh each call, so
+    in-place mutation still produces a fresh key."""
+    immutable = isinstance(v, jax.Array) or (
+        isinstance(v, np.ndarray) and not v.flags.writeable
+    )
+    if immutable:
         cache = self.__dict__.setdefault("_arr_digest_cache", {})
         hit = cache.get(id(v))
         if hit is None:
@@ -90,6 +95,8 @@ def _cached_hashable(self, v: Any) -> Any:
             # hold a reference so id() can't be recycled
             cache[(id(v), "ref")] = v
         return hit
+    if isinstance(v, (np.ndarray, jax.Array)):
+        return _hashable(v)
     if isinstance(v, (list, tuple)):
         return tuple(_cached_hashable(self, x) for x in v)
     if isinstance(v, dict):
@@ -306,16 +313,47 @@ class Transformer(Chainable, TransformerOperator):
     """
 
     vmap_batch: bool = True
+    # shape-bucketed vmap for ragged items-mode data: group items by
+    # shape, one jit(vmap) dispatch per group. Per-image host mapping of
+    # featurizers costs ~100 ms/image through a remote dispatch link;
+    # bucketing runs the same code ~35x faster (measured: dense SIFT at
+    # 256x256 — 9.3 imgs/s host-mapped vs 335 imgs/s bucketed).
+    bucket_vmap: bool = False
 
     def apply(self, x: Any) -> Any:  # single datum
         raise NotImplementedError
 
+    def _jitted_vmap(self):
+        fn = self.__dict__.get("_vmapped_apply")
+        if fn is None:
+            fn = jax.jit(jax.vmap(self.apply))
+            self.__dict__["_vmapped_apply"] = fn
+        return fn
+
     def apply_batch(self, ds: Dataset) -> Dataset:
-        if ds.is_array and self.vmap_batch:
+        if ds.is_array and (self.vmap_batch or self.bucket_vmap):
             return Dataset.from_array(
-                jax.vmap(self.apply)(ds.padded()), n=ds.n
+                self._jitted_vmap()(ds.padded()), n=ds.n
             )
+        if self.bucket_vmap:
+            return self._bucketed_batch(ds)
         return ds.map(self.apply)
+
+    def _bucketed_batch(self, ds: Dataset) -> Dataset:
+        items = ds.items()
+        by_shape: Dict[tuple, List[int]] = {}
+        arrays = []
+        for i, x in enumerate(items):
+            a = jnp.asarray(x)
+            arrays.append(a)
+            by_shape.setdefault((a.shape, str(a.dtype)), []).append(i)
+        out: List[Any] = [None] * len(items)
+        fn = self._jitted_vmap()
+        for idxs in by_shape.values():
+            res = fn(jnp.stack([arrays[i] for i in idxs]))
+            for j, i in enumerate(idxs):
+                out[i] = jax.tree_util.tree_map(lambda a, j=j: a[j], res)
+        return Dataset.from_items(out)
 
     # TransformerOperator ABI
     def single_transform(self, inputs: Sequence[Any]) -> Any:

@@ -57,9 +57,25 @@ class PipelineEnv:
     # content-addressed cache keyed by structural prefix hash; persisting
     # it lets re-built pipelines in a NEW process skip recompute) --------
 
-    def save_state(self, path: str) -> None:
-        """Persist every materialized prefix expression. Unevaluated
-        (never-forced) expressions are skipped rather than forced."""
+    def save_state(
+        self,
+        path: str,
+        *,
+        large_array_bytes: int = 1 << 20,
+        max_total_bytes: Optional[int] = None,
+    ) -> None:
+        """Persist every materialized prefix expression to a directory:
+        ``index.pkl`` plus one ``.npy`` file per large array.
+
+        Arrays over ``large_array_bytes`` stream to their own file one at
+        a time (device -> host -> disk, then released) so a flagship-scale
+        cached feature dataset never needs the whole state resident on
+        host at once. ``max_total_bytes`` caps what gets written: an
+        entry that would exceed the budget is skipped whole (its partial
+        files are removed and un-charged), in state-iteration order.
+        Unevaluated (never-forced) expressions are skipped, not forced.
+        """
+        import os
         import pickle
 
         import jax
@@ -67,32 +83,88 @@ class PipelineEnv:
 
         from keystone_tpu.parallel.dataset import Dataset
 
-        out = {}
+        os.makedirs(path, exist_ok=True)
+        index = {}
+        written = 0
+        counter = 0
+
+        def persist_tree(tree):
+            """Replace large arrays with .npy file references; returns
+            the persisted tree, or None (with files and budget rolled
+            back) if the entry would exceed the budget."""
+            nonlocal counter, written
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            out_leaves = []
+            entry_files = []
+            entry_bytes = 0
+
+            def rollback():
+                nonlocal written
+                for f in entry_files:
+                    try:
+                        os.remove(os.path.join(path, f))
+                    except OSError:
+                        pass
+                written -= entry_bytes
+
+            for leaf in leaves:
+                if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                    a = np.asarray(leaf)
+                    if (
+                        max_total_bytes is not None
+                        and written + a.nbytes > max_total_bytes
+                    ):
+                        rollback()
+                        return None
+                    if a.nbytes >= large_array_bytes:
+                        fname = f"arr{counter:05d}.npy"
+                        counter += 1
+                        np.save(os.path.join(path, fname), a)
+                        written += a.nbytes
+                        entry_bytes += a.nbytes
+                        entry_files.append(fname)
+                        out_leaves.append(("npy", fname))
+                        del a
+                        continue
+                    written += a.nbytes
+                    entry_bytes += a.nbytes
+                    out_leaves.append(("arr", a))
+                else:
+                    out_leaves.append(("raw", leaf))
+            return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
         for prefix, expr in self.state.items():
             if not expr.is_computed:
                 continue
             value = expr.get()
             if isinstance(value, Dataset):
                 if value.is_array:
-                    arrs = jax.tree_util.tree_map(
-                        np.asarray, value.padded()
-                    )
-                    value = ("dataset_array", arrs, value.n)
+                    tree = persist_tree(value.padded())
+                    if tree is None:
+                        continue
+                    entry = ("dataset_array", tree, value.n)
                 else:
-                    value = ("dataset_items", value.items(), None)
+                    tree = persist_tree(value.items())
+                    if tree is None:
+                        continue
+                    entry = ("dataset_items", tree, None)
             else:
-                value = ("raw", value, None)
+                entry = ("raw", value, None)
             try:
-                pickle.dumps(value)
+                pickle.dumps(entry)
             except Exception:
                 continue  # unpicklable (e.g. closure-defined transformer)
-            out[prefix] = value
-        with open(path, "wb") as f:
-            pickle.dump(out, f)
+            index[prefix] = entry
+        with open(os.path.join(path, "index.pkl"), "wb") as f:
+            pickle.dump(index, f)
 
     def load_state(self, path: str) -> int:
         """Load persisted prefix state; returns the number of entries."""
+        import os
         import pickle
+
+        import jax
+        import numpy as np
 
         from keystone_tpu.parallel.dataset import Dataset
         from keystone_tpu.workflow.expressions import (
@@ -100,14 +172,30 @@ class PipelineEnv:
             DatumExpression,
         )
 
-        with open(path, "rb") as f:
+        with open(os.path.join(path, "index.pkl"), "rb") as f:
             saved = pickle.load(f)
+
+        def restore_tree(tree):
+            def restore(leaf):
+                kind, payload = leaf
+                if kind == "npy":
+                    return np.load(os.path.join(path, payload))
+                return payload
+
+            return jax.tree_util.tree_map(
+                restore, tree,
+                is_leaf=lambda x: isinstance(x, tuple)
+                and len(x) == 2
+                and isinstance(x[0], str)
+                and x[0] in ("npy", "arr", "raw"),
+            )
+
         for prefix, (kind, payload, n) in saved.items():
             if kind == "dataset_array":
-                ds = Dataset.from_array(payload, n=n)
+                ds = Dataset.from_array(restore_tree(payload), n=n)
                 self.state[prefix] = DatasetExpression.of(ds)
             elif kind == "dataset_items":
-                ds = Dataset.from_items(payload)
+                ds = Dataset.from_items(restore_tree(payload))
                 self.state[prefix] = DatasetExpression.of(ds)
             else:
                 self.state[prefix] = DatumExpression.of(payload)

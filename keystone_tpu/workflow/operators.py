@@ -41,11 +41,12 @@ class Operator:
         return hash(self.eq_key())
 
     def __getstate__(self):
-        # the eq_key digest cache holds array references keyed by id() —
         # process-local state that must not bloat or poison pickles
-        # (FittedPipeline.save)
+        # (FittedPipeline.save): the eq_key digest cache holds array
+        # references keyed by id(); the vmap cache holds a jitted closure
         state = dict(self.__dict__)
         state.pop("_arr_digest_cache", None)
+        state.pop("_vmapped_apply", None)
         return state
 
 

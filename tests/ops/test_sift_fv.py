@@ -194,3 +194,27 @@ def test_fisher_vector_estimator_end_to_end():
     fv = est.fit(Dataset.from_items(mats))
     out = fv.apply(mats[0])
     assert np.asarray(out).shape == (8, 4)
+
+
+def test_fused_fisher_vector_matches_numpy_on_voc_codebook():
+    """Same reference-codebook check for the fused Pallas path
+    (the enceval-native parallel, external/FisherVector.scala:17)."""
+    from keystone_tpu.ops.images.fisher_vector import FisherVectorFused
+
+    gmm = GaussianMixtureModel.load(
+        f"{VOC_CODEBOOK}/means.csv",
+        f"{VOC_CODEBOOK}/variances.csv",
+        f"{VOC_CODEBOOK}/priors",
+    )
+    rng = np.random.default_rng(0)
+    d = gmm.dim
+    x = rng.standard_normal((d, 50)).astype(np.float32) * 100
+    got = np.asarray(FisherVectorFused(gmm).apply(x))
+    expect = _np_fisher_vector(
+        np.asarray(gmm.means, np.float64),
+        np.asarray(gmm.variances, np.float64),
+        np.asarray(gmm.weights, np.float64),
+        x.astype(np.float64),
+    )
+    assert got.shape == (d, 2 * gmm.k)
+    np.testing.assert_allclose(got, expect, rtol=2e-2, atol=2e-2)

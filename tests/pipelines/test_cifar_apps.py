@@ -81,3 +81,21 @@ def test_random_patch_cifar_augmented(mesh8):
     )
     _, metrics = random_patch_cifar_augmented(train, test, conf)
     assert 0.0 <= metrics.total_accuracy <= 1.0
+
+
+def test_random_patch_cifar_augmented_kernel(mesh8):
+    """Augmented train crops + random flips, KRR solve, augmented-test
+    merge (reference: RandomPatchCifarAugmentedKernel.scala:33)."""
+    from keystone_tpu.pipelines.images.cifar_apps import (
+        RandomCifarAugmentedKernelConfig,
+        random_patch_cifar_augmented_kernel,
+    )
+
+    train, test = synthetic_cifar(n_train=48, n_test=12, seed=4)
+    conf = RandomCifarAugmentedKernelConfig(
+        num_filters=8, patch_size=6, patch_steps=4, lam=1.0,
+        augment_patch_size=24, augment_copies=3,
+        gamma=1e-2, block_size=48, num_epochs=2,
+    )
+    _, metrics = random_patch_cifar_augmented_kernel(train, test, conf)
+    assert metrics.total_accuracy > 0.5  # learns on separable textures

@@ -35,3 +35,72 @@ def test_native_cifar_roundtrip(tmp_path):
 def test_native_library_built():
     # the shared library builds in this environment (g++ is baked in)
     assert native_available()
+
+
+@pytest.mark.skipif(not native_available(), reason="no native lib")
+def test_native_csv_edge_cases_agree_with_numpy(tmp_path):
+    """The C++ parser and the numpy fallback must agree on whitespace,
+    scientific notation, negative zero, and trailing newlines."""
+    cases = {
+        "plain": "1.5,2.5\n-3.25,4e-2\n",
+        "scientific": "1e10,-2.5E-3\n+0.0,-0.0\n",
+        "no_trailing_newline": "9,8\n7,6",
+        "blank_trailing_lines": "1,2\n3,4\n\n\n",
+        "spaces_around_values": " 1.0 , 2.0 \n 3.0 , 4.0 \n",
+        "single_row": "5,6,7\n",
+        "single_col": "1\n2\n3\n",
+    }
+    for name, text in cases.items():
+        p = tmp_path / f"{name}.csv"
+        p.write_text(text)
+        got = read_csv_f32(str(p))
+        expect = np.loadtxt(p, delimiter=",", dtype=np.float32, ndmin=2)
+        np.testing.assert_allclose(got, expect, rtol=1e-6, err_msg=name)
+        assert got.shape == expect.shape, name
+
+
+@pytest.mark.skipif(not native_available(), reason="no native lib")
+def test_native_csv_ragged_falls_back(tmp_path):
+    """Ragged rows must not silently mis-parse: the wrapper falls back to
+    numpy, which raises its usual error."""
+    p = tmp_path / "ragged.csv"
+    p.write_text("1,2,3\n4,5\n")
+    with pytest.raises(ValueError):
+        read_csv_f32(str(p))
+
+
+@pytest.mark.skipif(not native_available(), reason="no native lib")
+def test_native_csv_large_file(tmp_path):
+    """The C++ layer's reason to exist is large-file throughput (measured
+    ~2x np.loadtxt warm on one core); this asserts correctness at that
+    scale — wall-clock assertions are too flake-prone for CI."""
+    rng = np.random.default_rng(0)
+    arr = rng.standard_normal((40_000, 128)).astype(np.float32)
+    p = tmp_path / "big.csv"
+    np.savetxt(p, arr, delimiter=",", fmt="%.6e")
+
+    got = read_csv_f32(str(p))
+    expect = np.loadtxt(p, delimiter=",", dtype=np.float32, ndmin=2)
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+@pytest.mark.skipif(not native_available(), reason="no native lib")
+def test_native_cifar_truncated_record_ignored(tmp_path):
+    """A trailing partial record (torn write) is ignored, matching the
+    numpy fallback's floor-division record count."""
+    rng = np.random.default_rng(2)
+    n, dim, c = 3, 8, 3
+    rec = np.concatenate(
+        [
+            rng.integers(0, 10, (n, 1)).astype(np.uint8),
+            rng.integers(0, 256, (n, c * dim * dim)).astype(np.uint8),
+        ],
+        axis=1,
+    )
+    p = tmp_path / "trunc.bin"
+    with open(p, "wb") as f:
+        f.write(rec.tobytes())
+        f.write(b"\x01\x02\x03")  # partial 4th record
+    labels, images = read_cifar(str(p), c, dim)
+    assert labels.shape == (n,)
+    assert images.shape == (n, dim, dim, c)

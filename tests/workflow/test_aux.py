@@ -56,7 +56,7 @@ def test_prefix_state_persistence_across_reset(tmp_path, mesh8):
     assert calls["n"] == 1
 
     env = PipelineEnv.get_or_create()
-    path = tmp_path / "state.pkl"
+    path = tmp_path / "state"  # save_state writes a directory
     env.save_state(str(path))
     env.reset()
 
@@ -143,3 +143,41 @@ def test_optimizer_rule_trace_logging(caplog):
     ]
     assert merges, "CSE merge should have been logged"
     assert "-> " in merges[0].getMessage()
+
+
+def test_save_state_large_arrays_per_file_and_budget(tmp_path):
+    """Large arrays persist to individual .npy files (streamed, not one
+    monolithic pickle) and max_total_bytes drops over-budget entries."""
+    import os
+
+    from keystone_tpu.workflow.executor import PipelineEnv
+    from keystone_tpu.workflow.expressions import DatasetExpression
+    from keystone_tpu.parallel.dataset import Dataset
+
+    env = PipelineEnv.get_or_create()
+    big = np.ones((600, 600), np.float32)  # 1.44 MB > 1 MB threshold
+    small = np.ones((4, 4), np.float32)
+    env.state["bigp"] = DatasetExpression.of(Dataset.from_array(big))
+    env.state["smallp"] = DatasetExpression.of(Dataset.from_array(small))
+    # force both
+    env.state["bigp"].get(); env.state["smallp"].get()
+
+    d = tmp_path / "state"
+    env.save_state(str(d))
+    npys = [f for f in os.listdir(d) if f.endswith(".npy")]
+    assert len(npys) == 1  # only the big array got its own file
+    env.reset()
+    assert env.load_state(str(d)) == 2
+    restored = env.state["bigp"].get().padded()
+    np.testing.assert_allclose(np.asarray(restored), big)
+
+    # budget smaller than the big array: entry dropped, small kept
+    env.reset()
+    env.state["bigp"] = DatasetExpression.of(Dataset.from_array(big))
+    env.state["smallp"] = DatasetExpression.of(Dataset.from_array(small))
+    env.state["bigp"].get(); env.state["smallp"].get()
+    d2 = tmp_path / "state2"
+    env.save_state(str(d2), max_total_bytes=1 << 20)
+    env.reset()
+    assert env.load_state(str(d2)) == 1
+    assert "smallp" in env.state and "bigp" not in env.state

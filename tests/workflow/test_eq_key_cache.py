@@ -4,6 +4,7 @@ with total parameter bytes)."""
 
 import dataclasses
 
+import jax.numpy as jnp
 import numpy as np
 
 from keystone_tpu.workflow import api
@@ -27,12 +28,23 @@ def test_array_digest_called_once_per_instance(monkeypatch):
         return real(a)
 
     monkeypatch.setattr(api, "_array_digest", counting)
-    t = BigModel(W=np.ones((512, 256), np.float32))
+    # jnp arrays (and frozen np arrays) are immutable -> digest cached
+    t = BigModel(W=jnp.ones((512, 256), jnp.float32))
     k1 = t.eq_key()
     k2 = t.eq_key()
     k3 = t.eq_key()
     assert k1 == k2 == k3
     assert len(calls) == 1  # one serialization ever
+
+
+def test_mutable_np_array_not_cached(monkeypatch):
+    """Writeable np.ndarray fields must be re-digested each call: in-place
+    mutation has to produce a fresh key (identity caching would go
+    stale)."""
+    t = BigModel(W=np.zeros((8, 8), np.float32))
+    k1 = t.eq_key()
+    t.W[0, 0] = 5.0  # in-place mutation
+    assert t.eq_key() != k1
 
 
 def test_scalar_field_mutation_refreshes_key(monkeypatch):
@@ -56,7 +68,7 @@ def test_scalar_field_mutation_refreshes_key(monkeypatch):
 def test_digest_cache_not_pickled():
     import pickle
 
-    t = BigModel(W=np.ones((64, 64), np.float32))
+    t = BigModel(W=jnp.ones((64, 64), jnp.float32))
     t.eq_key()
     assert "_arr_digest_cache" in t.__dict__
     t2 = pickle.loads(pickle.dumps(t))
