@@ -31,10 +31,15 @@ from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from keystone_tpu.parallel.dataset import Dataset
 from keystone_tpu.workflow.api import LabelEstimator, Transformer
 from keystone_tpu.ops.learning.hostsolve import psd_solve_host
+from keystone_tpu.utils.checkpoint import (
+    LoopCheckpointer,
+    two_level_schedule,
+)
 
 
 def _f32_mm(a, b):
@@ -215,6 +220,14 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     # syncs — the fast path) | "host" (f64 LAPACK per block, for
     # pathologically conditioned systems; costs a dispatch round-trip
     # per block)
+    checkpoint_path: Optional[str] = None  # periodic loop-state snapshot;
+    # a re-run with the same path resumes at the last completed block
+    # (reference: lineage checkpoint every 25 blocks,
+    # KernelRidgeRegression.scala:200-210 — see utils/checkpoint.py)
+    checkpoint_every: int = 25
+    block_callback: Optional[Callable[[int], None]] = None  # called with a
+    # running count after each completed block update (per-block progress
+    # logging in the reference driver loop)
 
     def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
         # Mean-centering of features and labels (reference fits
@@ -239,27 +252,72 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             for s in range(0, D, self.block_size)
         ]
         Wb = {s: jnp.zeros((w, k), jnp.float32) for s, w in blocks}
-        for _ in range(self.num_iter):
-            for s, w in blocks:
-                mu_b = jax.lax.dynamic_slice_in_dim(mu, s, w)
-                if self.solve == "device":
-                    # whole block update in one dispatch; the entire fit
-                    # stays in the async stream — no host sync until the
-                    # caller consumes W.
-                    Wb[s], R = _block_step(
-                        X, R, Wb[s], mu_b, mask, s, self.lam,
-                        width=w, n=n,
-                    )
-                else:
-                    gram, rhs, R_plus = _block_stats(
-                        X, R, Wb[s], mu_b, mask, s, width=w, n=n
-                    )
-                    # (b,b) solve on host in f64 (reference: driver-side
-                    # NormalEquations solve) — see hostsolve.py.
-                    Wb[s] = jnp.asarray(psd_solve_host(gram, rhs, self.lam))
-                    R = _residual_update(
-                        X, R_plus, Wb[s], mu_b, mask, s, width=w
-                    )
+
+        ckpt = None
+        start_it, start_pos = 0, 0
+        if self.checkpoint_path is not None:
+            # stamp config + problem shape + a cheap data probe so a
+            # snapshot from a different fit is discarded, not resumed
+            fp = (
+                f"bls bs={self.block_size} it={self.num_iter} "
+                f"lam={self.lam} solve={self.solve} n={n} D={D} k={k} "
+                f"probe={float(jnp.sum(X[0].astype(jnp.float32))):.6e}/"
+                f"{float(jnp.sum(Y[0].astype(jnp.float32))):.6e}"
+            )
+            ckpt = LoopCheckpointer(self.checkpoint_path,
+                                    self.checkpoint_every, fingerprint=fp)
+            state = ckpt.load()
+            if state is not None:
+                start_it = int(state["it"])
+                start_pos = int(state["pos"])
+                for s, w in blocks:
+                    if not np.any(state[f"Wb_{s}"]):
+                        continue  # untouched block: zero contribution
+                    Wb[s] = jnp.asarray(state[f"Wb_{s}"], jnp.float32)
+                    # Rebuild the residual from the compact snapshot —
+                    # the lineage-truncation analogue: recompute the big
+                    # intermediate instead of persisting it.
+                    mu_b = jax.lax.dynamic_slice_in_dim(mu, s, w)
+                    R = _residual_update(X, R, Wb[s], mu_b, mask, s, width=w)
+
+        def snapshot(next_it: int, next_pos: int):
+            st = {"it": next_it, "pos": next_pos}
+            for s, _ in blocks:
+                st[f"Wb_{s}"] = np.asarray(Wb[s])
+            return st
+
+        done = 0
+        for it, pos, nxt in two_level_schedule(
+            self.num_iter, len(blocks), (start_it, start_pos)
+        ):
+            s, w = blocks[pos]
+            mu_b = jax.lax.dynamic_slice_in_dim(mu, s, w)
+            if self.solve == "device":
+                # whole block update in one dispatch; the entire fit
+                # stays in the async stream — no host sync until the
+                # caller consumes W.
+                Wb[s], R = _block_step(
+                    X, R, Wb[s], mu_b, mask, s, self.lam,
+                    width=w, n=n,
+                )
+            else:
+                gram, rhs, R_plus = _block_stats(
+                    X, R, Wb[s], mu_b, mask, s, width=w, n=n
+                )
+                # (b,b) solve on host in f64 (reference: driver-side
+                # NormalEquations solve) — see hostsolve.py.
+                Wb[s] = jnp.asarray(psd_solve_host(gram, rhs, self.lam))
+                R = _residual_update(
+                    X, R_plus, Wb[s], mu_b, mask, s, width=w
+                )
+            done += 1
+            if ckpt is not None:
+                ckpt.tick(lambda: snapshot(*nxt))
+            if self.block_callback is not None:
+                self.block_callback(done)
+        if ckpt is not None:
+            ckpt.clear()  # fit completed; stale state must not leak into
+            # a later fit at the same path
         W = jnp.concatenate([Wb[s] for s, _ in blocks], axis=0)
         return BlockLinearMapper(
             W,

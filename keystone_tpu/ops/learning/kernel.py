@@ -15,7 +15,9 @@ TPU-native: the kernel column block is one fused jitted expression
 (‖x‖² + ‖x_B‖² − 2·X X_Bᵀ → exp), the b×k residual contraction psums over
 the sharded example axis, the small (b, b) solve goes to the host in f64
 (hostsolve.py), and the model update is a dynamic_update_slice — no
-broadcast variables, no lineage checkpointing (no lineage).
+broadcast variables. The reference's every-25-blocks lineage checkpoint
+becomes a cadenced atomic host snapshot of the model that ``fit`` resumes
+from after preemption (``checkpoint_path``; utils/checkpoint.py).
 """
 
 from __future__ import annotations
@@ -31,6 +33,10 @@ import numpy as np
 from keystone_tpu.ops.learning.block_ls import _f32_mm
 from keystone_tpu.ops.learning.hostsolve import psd_solve_host
 from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.utils.checkpoint import (
+    LoopCheckpointer,
+    two_level_schedule,
+)
 from keystone_tpu.workflow.api import Estimator, LabelEstimator, Transformer
 
 
@@ -214,6 +220,28 @@ class KernelRidgeRegression(LabelEstimator):
     block_size: int
     num_epochs: int
     block_permuter: Optional[int] = None
+    checkpoint_path: Optional[str] = None  # periodic model snapshot every
+    # ``checkpoint_every`` block solves; a re-run with the same path
+    # resumes at the last completed block (reference checkpoints lineage
+    # every 25 blocks: KernelRidgeRegression.scala:200-210)
+    checkpoint_every: int = 25
+    block_callback: Optional[Any] = None  # called with a running count
+    # after each completed block solve
+
+    def _epoch_order(self, epoch: int, n_blocks: int) -> List[int]:
+        """Block order for an epoch, seeded per (permuter, epoch) so a
+        resumed fit replays the identical schedule.
+
+        NOTE: this changed the schedule for a given ``block_permuter``
+        relative to the pre-checkpointing implementation (one RNG stream
+        across epochs); models fit with the same seed before/after differ
+        numerically (both are valid Gauss-Seidel orders)."""
+        order = list(range(n_blocks))
+        if self.block_permuter is not None:
+            np.random.default_rng(
+                (self.block_permuter, epoch)
+            ).shuffle(order)
+        return order
 
     def fit(self, data: Dataset, labels: Dataset) -> KernelBlockLinearMapper:
         data = data.to_array_mode()
@@ -229,30 +257,58 @@ class KernelRidgeRegression(LabelEstimator):
             (s, min(s + self.block_size, n_pad) - s)
             for s in range(0, n_pad, self.block_size)
         ]
-        rng = (
-            np.random.default_rng(self.block_permuter)
-            if self.block_permuter is not None
-            else None
-        )
         W = jnp.zeros((n_pad, k), jnp.float32)
-        for _ in range(self.num_epochs):
-            order = list(range(len(blocks)))
-            if rng is not None:
-                rng.shuffle(order)
-            for bi in order:
-                s, wd = blocks[bi]
-                K_block = transformer.train_block(s, wd)  # (n_pad, b)
-                resid, K_bb = _krr_residual(K_block, W, s, width=wd)
-                Wb_old = jax.lax.dynamic_slice_in_dim(W, s, wd, axis=0)
-                y_b = jax.lax.dynamic_slice_in_dim(Y, s, wd, axis=0)
-                rhs = y_b - (resid - _f32_mm(K_bb.T, Wb_old))
-                # pad rows inside the block: K_bb row/col is zero there,
-                # λI makes the system nonsingular and W stays 0 via rhs=0
-                Wb_new = jnp.asarray(
-                    psd_solve_host(K_bb, np.asarray(rhs), self.lam),
-                    jnp.float32,
-                )
-                W = _krr_update_model(W, Wb_new, s, width=wd)
+
+        ckpt = None
+        start_epoch, start_pos = 0, 0
+        if self.checkpoint_path is not None:
+            # n_pad is stamped too: the snapshot W and block layout are
+            # n_pad-shaped, and n_pad varies with mesh shard count
+            fp = (
+                f"krr bs={self.block_size} ep={self.num_epochs} "
+                f"lam={self.lam} gamma={self.kernel_generator.gamma} "
+                f"perm={self.block_permuter} n={n} n_pad={n_pad} k={k} "
+                f"probe={float(jnp.sum(X[0])):.6e}/"
+                f"{float(jnp.sum(Y[0])):.6e}"
+            )
+            ckpt = LoopCheckpointer(self.checkpoint_path,
+                                    self.checkpoint_every, fingerprint=fp)
+            state = ckpt.load()
+            if state is not None:
+                W = jnp.asarray(state["W"], jnp.float32)
+                start_epoch = int(state["epoch"])
+                start_pos = int(state["pos"])
+
+        done = 0
+        order, order_epoch = [], -1
+        for epoch, pos, nxt in two_level_schedule(
+            self.num_epochs, len(blocks), (start_epoch, start_pos)
+        ):
+            if epoch != order_epoch:
+                order = self._epoch_order(epoch, len(blocks))
+                order_epoch = epoch
+            s, wd = blocks[order[pos]]
+            K_block = transformer.train_block(s, wd)  # (n_pad, b)
+            resid, K_bb = _krr_residual(K_block, W, s, width=wd)
+            Wb_old = jax.lax.dynamic_slice_in_dim(W, s, wd, axis=0)
+            y_b = jax.lax.dynamic_slice_in_dim(Y, s, wd, axis=0)
+            rhs = y_b - (resid - _f32_mm(K_bb.T, Wb_old))
+            # pad rows inside the block: K_bb row/col is zero there,
+            # λI makes the system nonsingular and W stays 0 via rhs=0
+            Wb_new = jnp.asarray(
+                psd_solve_host(K_bb, np.asarray(rhs), self.lam),
+                jnp.float32,
+            )
+            W = _krr_update_model(W, Wb_new, s, width=wd)
+            done += 1
+            if ckpt is not None:
+                ckpt.tick(lambda: {
+                    "W": np.asarray(W), "epoch": nxt[0], "pos": nxt[1],
+                })
+            if self.block_callback is not None:
+                self.block_callback(done)
+        if ckpt is not None:
+            ckpt.clear()
 
         return KernelBlockLinearMapper(
             W, self.block_size, transformer, n

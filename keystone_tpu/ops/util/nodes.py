@@ -182,15 +182,41 @@ class Sparsify(Transformer):
 
 class Shuffler(Transformer):
     """Random permutation of examples (reference: repartition-based
-    Shuffler). Host-side; mainly useful before per-class grouping."""
+    Shuffler). ``device=True`` routes rows through one ``lax.all_to_all``
+    over the mesh's data axis (parallel/shuffle.py) — the shuffle never
+    leaves the devices; the default host path materializes and permutes
+    (bit-identical results either way)."""
 
-    def __init__(self, seed: int = 0):
+    def __init__(self, seed: int = 0, device: bool = False):
         self.seed = seed
+        self.device = device
 
     def apply(self, x):
         return x
 
     def apply_batch(self, ds: Dataset) -> Dataset:
+        if (
+            self.device
+            and ds.is_array
+            and not isinstance(ds.padded(), tuple)
+        ):
+            from keystone_tpu.parallel import mesh as mesh_lib
+            from keystone_tpu.parallel.shuffle import device_shuffle
+
+            mesh = mesh_lib.current_mesh()
+            x = ds.padded()
+            if x.shape[0] % mesh_lib.n_data_shards(mesh) == 0:
+                return Dataset.from_array(
+                    device_shuffle(x, ds.n, self.seed, mesh), n=ds.n
+                )
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "Shuffler(device=True): %d padded rows not divisible by "
+                "%d data shards; falling back to the host path (full "
+                "array materializes on host)",
+                x.shape[0], mesh_lib.n_data_shards(mesh),
+            )
         rng = np.random.default_rng(self.seed)
         perm = rng.permutation(ds.n)
         if ds.is_array and not isinstance(ds.padded(), tuple):

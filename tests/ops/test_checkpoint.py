@@ -1,0 +1,148 @@
+"""Failure recovery: cadenced loop checkpointing + resume in the block
+solvers (reference: KernelRidgeRegression.scala:200-210 checkpoints
+lineage every 25 blocks; here the loop state snapshots to disk and a
+re-run resumes at the last completed block)."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.learning import BlockLeastSquaresEstimator
+from keystone_tpu.ops.learning.kernel import (
+    GaussianKernelGenerator,
+    KernelRidgeRegression,
+)
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.utils.checkpoint import LoopCheckpointer
+
+
+class _Interrupt(Exception):
+    pass
+
+
+def _fail_after(k):
+    def cb(count):
+        if count >= k:
+            raise _Interrupt
+    return cb
+
+
+def test_loop_checkpointer_cadence_and_atomicity(tmp_path):
+    p = str(tmp_path / "state.npz")
+    ck = LoopCheckpointer(p, every=3)
+    saves = []
+    for i in range(7):
+        ck.tick(lambda: saves.append(i) or {"i": np.int64(i)})
+    assert saves == [2, 5]  # steps 3 and 6
+    st = ck.load()
+    assert int(st["i"]) == 5
+    ck.clear()
+    assert ck.load() is None
+
+
+def test_block_ls_resume_matches_uninterrupted(tmp_path):
+    rng = np.random.default_rng(0)
+    n, d, k = 96, 40, 3
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    Y = (X @ rng.standard_normal((d, k))).astype(np.float32)
+    Xd = Dataset.from_array(jnp.asarray(X))
+    Yd = Dataset.from_array(jnp.asarray(Y))
+
+    base = BlockLeastSquaresEstimator(block_size=16, num_iter=2, lam=0.1)
+    W_ref = np.asarray(base.fit(Xd, Yd).W)
+
+    p = str(tmp_path / "bls.npz")
+    # interrupt mid second sweep (3 blocks/sweep): checkpoint every 2
+    # blocks, die after 4 completed block updates
+    est = dataclasses.replace(
+        base, checkpoint_path=p, checkpoint_every=2,
+        block_callback=_fail_after(4),
+    )
+    with pytest.raises(_Interrupt):
+        est.fit(Xd, Yd)
+    assert LoopCheckpointer(p).load() is not None
+
+    resumed = dataclasses.replace(base, checkpoint_path=p,
+                                  checkpoint_every=2)
+    W_res = np.asarray(resumed.fit(Xd, Yd).W)
+    np.testing.assert_allclose(W_res, W_ref, rtol=2e-4, atol=2e-5)
+    # completed fit clears its snapshot so it can't leak into a later fit
+    assert LoopCheckpointer(p).load() is None
+
+
+def test_krr_resume_matches_uninterrupted(tmp_path):
+    rng = np.random.default_rng(1)
+    n, d, k = 64, 8, 2
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    Y = rng.standard_normal((n, k)).astype(np.float32)
+    Xd = Dataset.from_array(jnp.asarray(X))
+    Yd = Dataset.from_array(jnp.asarray(Y))
+
+    base = KernelRidgeRegression(
+        GaussianKernelGenerator(gamma=0.05), lam=0.5, block_size=16,
+        num_epochs=2, block_permuter=7,
+    )
+    W_ref = np.asarray(base.fit(Xd, Yd).model)
+
+    p = str(tmp_path / "krr.npz")
+    est = dataclasses.replace(
+        base, checkpoint_path=p, checkpoint_every=1,
+        block_callback=_fail_after(5),
+    )
+    with pytest.raises(_Interrupt):
+        est.fit(Xd, Yd)
+
+    resumed = dataclasses.replace(base, checkpoint_path=p,
+                                  checkpoint_every=1)
+    W_res = np.asarray(resumed.fit(Xd, Yd).model)
+    np.testing.assert_allclose(W_res, W_ref, rtol=1e-5, atol=1e-6)
+    assert LoopCheckpointer(p).load() is None
+
+
+def test_krr_shuffled_schedule_is_deterministic_per_epoch():
+    est = KernelRidgeRegression(
+        GaussianKernelGenerator(gamma=0.1), lam=0.1, block_size=8,
+        num_epochs=3, block_permuter=42,
+    )
+    o0 = est._epoch_order(0, 6)
+    assert est._epoch_order(0, 6) == o0  # replayable
+    assert sorted(o0) == list(range(6))
+    assert o0 != est._epoch_order(1, 6) or o0 != est._epoch_order(2, 6)
+
+
+def test_stale_checkpoint_from_different_config_is_discarded(tmp_path):
+    rng = np.random.default_rng(2)
+    X = rng.standard_normal((64, 32)).astype(np.float32)
+    Y = (X @ rng.standard_normal((32, 2))).astype(np.float32)
+    Xd = Dataset.from_array(jnp.asarray(X))
+    Yd = Dataset.from_array(jnp.asarray(Y))
+
+    p = str(tmp_path / "bls.npz")
+    est = BlockLeastSquaresEstimator(
+        block_size=16, num_iter=2, lam=0.1, checkpoint_path=p,
+        checkpoint_every=1, block_callback=_fail_after(2),
+    )
+    with pytest.raises(_Interrupt):
+        est.fit(Xd, Yd)
+
+    # resume with a DIFFERENT lam: stale snapshot must be ignored, and the
+    # result must equal a fresh uninterrupted fit at the new lam
+    changed = BlockLeastSquaresEstimator(
+        block_size=16, num_iter=2, lam=5.0, checkpoint_path=p,
+        checkpoint_every=1,
+    )
+    W_res = np.asarray(changed.fit(Xd, Yd).W)
+    W_ref = np.asarray(
+        BlockLeastSquaresEstimator(block_size=16, num_iter=2, lam=5.0)
+        .fit(Xd, Yd).W
+    )
+    np.testing.assert_allclose(W_res, W_ref, rtol=1e-6)
+
+
+def test_corrupt_checkpoint_is_discarded(tmp_path):
+    p = str(tmp_path / "bad.npz")
+    with open(p, "wb") as f:
+        f.write(b"not an npz at all")
+    assert LoopCheckpointer(p, fingerprint="x").load() is None

@@ -1,0 +1,109 @@
+"""Device-side all_to_all shuffle/repartition (parallel/shuffle.py) on the
+virtual 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.parallel import mesh as mesh_lib
+from keystone_tpu.parallel.shuffle import (
+    all_to_all_repartition,
+    device_shuffle,
+    repartition_by_key,
+)
+
+
+def _mesh8():
+    return mesh_lib.make_mesh(n_data=8, n_model=1)
+
+
+def test_repartition_by_key_groups_classes():
+    mesh = _mesh8()
+    with mesh_lib.use_mesh(mesh):
+        rng = np.random.default_rng(0)
+        n, d = 128, 5
+        keys = rng.integers(0, 8, n).astype(np.int32)
+        x = rng.standard_normal((n, d)).astype(np.float32)
+        xs = jax.device_put(jnp.asarray(x), mesh_lib.data_sharding(mesh))
+        ks = jax.device_put(jnp.asarray(keys), mesh_lib.data_sharding(mesh, 1))
+
+        cap = 32  # >= max rows any one shard sends to one destination
+        (out,), valid, over = repartition_by_key((xs,), ks, cap, mesh)
+        assert int(over) == 0
+        out_h = np.asarray(out).reshape(8, -1, d)  # per-dest-shard blocks
+        valid_h = np.asarray(valid).reshape(8, -1).astype(bool)
+        # every valid row on shard j has key % 8 == j, and all rows arrive
+        got = []
+        for j in range(8):
+            rows = out_h[j][valid_h[j]]
+            for r in rows:
+                src = np.where((x == r).all(axis=1))[0]
+                assert len(src) == 1 and keys[src[0]] % 8 == j
+                got.append(src[0])
+        assert sorted(got) == list(range(n))
+
+
+def test_repartition_overflow_is_counted_not_silent():
+    mesh = _mesh8()
+    with mesh_lib.use_mesh(mesh):
+        n = 64
+        x = jnp.arange(n, dtype=jnp.float32)[:, None]
+        keys = jnp.zeros((n,), jnp.int32)  # everything to shard 0
+        xs = jax.device_put(x, mesh_lib.data_sharding(mesh))
+        ks = jax.device_put(keys, mesh_lib.data_sharding(mesh, 1))
+        (out,), valid, over = repartition_by_key((xs,), ks, 2, mesh)
+        # 8 rows/shard all headed to dest 0 with capacity 2 -> 6 dropped
+        # per source shard
+        assert int(over) == 8 * (8 - 2)
+        assert int(jnp.sum(valid)) == 8 * 2
+
+
+def test_repartition_discards_negative_keys():
+    mesh = _mesh8()
+    with mesh_lib.use_mesh(mesh):
+        n = 32
+        x = jnp.arange(n, dtype=jnp.float32)[:, None]
+        keys = jnp.where(jnp.arange(n) % 2 == 0, jnp.arange(n) % 8, -1)
+        xs = jax.device_put(x, mesh_lib.data_sharding(mesh))
+        ks = jax.device_put(
+            keys.astype(jnp.int32), mesh_lib.data_sharding(mesh, 1)
+        )
+        (out,), valid, over = repartition_by_key((xs,), ks, 8, mesh)
+        assert int(over) == 0
+        assert int(jnp.sum(valid)) == n // 2
+
+
+def test_device_shuffle_matches_host_permutation():
+    mesh = _mesh8()
+    with mesh_lib.use_mesh(mesh):
+        rng = np.random.default_rng(3)
+        n, n_pad, d = 50, 64, 4
+        x = np.zeros((n_pad, d), np.float32)
+        x[:n] = rng.standard_normal((n, d))
+        xs = jax.device_put(jnp.asarray(x), mesh_lib.data_sharding(mesh))
+
+        out = np.asarray(device_shuffle(xs, n, seed=11, mesh=mesh))
+        perm = np.random.default_rng(11).permutation(n)
+        np.testing.assert_array_equal(out[:n], x[:n][perm])
+        np.testing.assert_array_equal(out[n:], 0.0)
+
+
+def test_all_to_all_repartition_multi_payload():
+    mesh = _mesh8()
+    with mesh_lib.use_mesh(mesh):
+        n = 64
+        x = jnp.arange(n, dtype=jnp.float32)[:, None]
+        tag = jnp.arange(n, dtype=jnp.int32)
+        dest = (jnp.arange(n) % 8).astype(jnp.int32)
+        sh = mesh_lib.data_sharding
+        (xo, to), valid, over = all_to_all_repartition(
+            (jax.device_put(x, sh(mesh)), jax.device_put(tag, sh(mesh, 1))),
+            jax.device_put(dest, sh(mesh, 1)),
+            capacity=8, mesh=mesh,
+        )
+        assert int(over) == 0
+        v = np.asarray(valid).astype(bool)
+        # payload leaves stay row-aligned through the exchange
+        np.testing.assert_array_equal(
+            np.asarray(xo)[v][:, 0].astype(np.int32), np.asarray(to)[v]
+        )

@@ -1,0 +1,24 @@
+"""Shuffler node device path agrees bit-for-bit with the host path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.ops.util.nodes import Shuffler
+from keystone_tpu.parallel import mesh as mesh_lib
+from keystone_tpu.parallel.dataset import Dataset
+
+
+def test_shuffler_device_matches_host():
+    mesh = mesh_lib.make_mesh(n_data=8, n_model=1)
+    with mesh_lib.use_mesh(mesh):
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((64, 6)).astype(np.float32)
+        ds = Dataset.from_array(
+            jax.device_put(jnp.asarray(x), mesh_lib.data_sharding(mesh))
+        )
+        host = Shuffler(seed=5).apply_batch(ds)
+        dev = Shuffler(seed=5, device=True).apply_batch(ds)
+        np.testing.assert_array_equal(
+            np.asarray(dev.padded())[: dev.n], np.asarray(host.padded())
+        )
