@@ -1,14 +1,15 @@
-"""Wrappers for external NLP annotators: POS tagging, NER, lemmatizing
-feature extraction.
+"""POS tagging, NER, and lemmatizing feature extraction.
 
-Reference: nodes/nlp/POSTagger.scala:24, NER.scala:20 (Epic CRF/SemiCRF
-models broadcast to executors), CoreNLPFeatureExtractor.scala:18 (sista
-processors tokenize/lemmatize/NER-replace + n-grams). Those JVM model
-libraries have no in-environment equivalent; these nodes accept any
-callable annotator (e.g. a spaCy pipeline or a transformers
-token-classification pipeline loaded from a local path) and otherwise
-raise with instructions — keeping the API surface while making the
-external-model dependency explicit.
+Reference: nodes/nlp/POSTagger.scala:24, NER.scala:20 (pre-trained Epic
+CRF/SemiCRF models broadcast to executors), CoreNLPFeatureExtractor
+.scala:18 (sista processors tokenize/lemmatize/NER-replace + n-grams).
+The Epic/CoreNLP JVM model libraries have no in-environment equivalent,
+so these nodes default to the framework's own annotators (ops/nlp/
+tagging.py: a trainable averaged-perceptron tagger via
+``PerceptronTaggerEstimator``, plus rule-based POS/NER fallbacks) and
+accept any callable annotator (a spaCy pipeline, a transformers
+token-classification pipeline, or a trained ``_TrainedTagger``) in the
+reference's pass-a-model style.
 """
 
 from __future__ import annotations
@@ -18,53 +19,48 @@ import re
 from typing import Any, Callable, Optional, Sequence
 
 from keystone_tpu.ops.nlp.ngrams import NGramsFeaturizer
+from keystone_tpu.ops.nlp.tagging import rule_ner_tag, rule_pos_tag
 from keystone_tpu.workflow.api import Transformer
-
-_MISSING = (
-    "{name} needs an external annotator model. Pass `annotator=` — any "
-    "callable mapping a token list to per-token labels (e.g. a local "
-    "spaCy or transformers token-classification pipeline)."
-)
 
 
 @dataclasses.dataclass(eq=False)
 class POSTagger(Transformer):
-    """tokens -> (token, tag) pairs via a pluggable annotator."""
+    """tokens -> (token, tag) pairs. ``annotator`` maps a token list to
+    per-token tags; defaults to the rule-based tagger (train a better one
+    with ``PerceptronTaggerEstimator``)."""
 
     annotator: Optional[Callable[[Sequence[str]], Sequence[str]]] = None
     vmap_batch = False
 
     def apply(self, tokens: Sequence[str]):
-        if self.annotator is None:
-            raise RuntimeError(_MISSING.format(name="POSTagger"))
-        tags = self.annotator(tokens)
+        tags = (self.annotator or rule_pos_tag)(tokens)
         return list(zip(tokens, tags))
 
 
 @dataclasses.dataclass(eq=False)
 class NER(Transformer):
-    """tokens -> per-token entity labels via a pluggable annotator."""
+    """tokens -> per-token entity labels. Defaults to the heuristic
+    capitalization/gazetteer annotator (tagging.rule_ner_tag)."""
 
     annotator: Optional[Callable[[Sequence[str]], Sequence[str]]] = None
     vmap_batch = False
 
     def apply(self, tokens: Sequence[str]):
-        if self.annotator is None:
-            raise RuntimeError(_MISSING.format(name="NER"))
-        return list(self.annotator(tokens))
+        return list((self.annotator or rule_ner_tag)(tokens))
 
 
 @dataclasses.dataclass(eq=False)
 class CoreNLPFeatureExtractor(Transformer):
     """text -> n-grams over normalized tokens (reference:
     CoreNLPFeatureExtractor.scala — tokenize, lemmatize, replace NER
-    entities with their types, then n-grams). Without an external
-    lemmatizer/NER this falls back to lowercase tokenization with a
-    light rule-based normalizer, keeping the pipeline shape."""
+    entities with their types, then n-grams). Defaults: rule-based NER
+    replacement (tagging.rule_ner_tag) + a light rule-based stemmer;
+    pass ``lemmatizer``/``ner`` to swap in external annotators, or
+    ``ner=False`` to disable entity replacement."""
 
     orders: Sequence[int] = (1, 2, 3)
     lemmatizer: Optional[Callable[[str], str]] = None
-    ner: Optional[Callable[[Sequence[str]], Sequence[str]]] = None
+    ner: Any = None  # None=default rule_ner_tag | False=off | callable
     vmap_batch = False
 
     def _normalize(self, token: str) -> str:
@@ -79,8 +75,9 @@ class CoreNLPFeatureExtractor(Transformer):
 
     def apply(self, text: str):
         tokens = [t for t in re.split(r"[^\w]+", text) if t]
-        if self.ner is not None:
-            labels = self.ner(tokens)
+        ner = rule_ner_tag if self.ner is None else self.ner
+        if ner:
+            labels = ner(tokens)
             tokens = [
                 lab if lab and lab != "O" else tok
                 for tok, lab in zip(tokens, labels)

@@ -1,0 +1,81 @@
+"""Image representation conversions (ops/images/conversions.py) —
+round-trip exactness."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.images.conversions import (
+    bytes_to_image,
+    chw_to_hwc,
+    gray_to_rgb,
+    hwc_to_chw,
+    image_to_rgb_ints,
+    rgb_ints_to_image,
+    unvectorize,
+    vectorize,
+)
+
+
+def test_bytes_bgr_to_rgb():
+    # one 1x2 BGR image: pixel0 = (b=1,g=2,r=3), pixel1 = (4,5,6)
+    img = bytes_to_image(bytes([1, 2, 3, 4, 5, 6]), 1, 2, 3, order="bgr")
+    np.testing.assert_array_equal(
+        np.asarray(img), [[[3, 2, 1], [6, 5, 4]]]
+    )
+
+
+def test_bytes_abgr_drops_alpha():
+    img = bytes_to_image(
+        bytes([9, 1, 2, 3, 8, 4, 5, 6]), 1, 2, 4, order="abgr"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(img), [[[3, 2, 1], [6, 5, 4]]]
+    )
+
+
+def test_bytes_order_validation():
+    with pytest.raises(ValueError):
+        bytes_to_image(bytes(4), 1, 1, 4, order="bgr")
+    with pytest.raises(ValueError):
+        bytes_to_image(bytes(1), 1, 1, 1, order="nope")
+
+
+def test_gray_to_rgb_replicates():
+    g = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    rgb = gray_to_rgb(g)
+    assert rgb.shape == (2, 2, 3)
+    for c in range(3):
+        np.testing.assert_array_equal(np.asarray(rgb[:, :, c]), np.asarray(g))
+
+
+def test_packed_rgb_round_trip_exact():
+    rng = np.random.default_rng(0)
+    img = jnp.asarray(rng.integers(0, 256, (5, 7, 3)).astype(np.float32))
+    packed = image_to_rgb_ints(img)
+    back = rgb_ints_to_image(packed)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(img))
+
+
+def test_packed_rgb_scaling():
+    img = jnp.asarray([[[-1.0, 0.0, 3.0]]])  # out of byte range
+    packed = image_to_rgb_ints(img, scale=True)
+    back = np.asarray(rgb_ints_to_image(packed))[0, 0]
+    assert back[0] == 0 and back[2] == 255  # min -> 0, max -> 255
+
+
+def test_layout_round_trips():
+    rng = np.random.default_rng(1)
+    img = jnp.asarray(rng.standard_normal((4, 6, 3)).astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(chw_to_hwc(hwc_to_chw(img))), np.asarray(img)
+    )
+    v = vectorize(img)
+    assert v.shape == (4 * 6 * 3,)
+    # channel-major: first H*W entries are channel 0
+    np.testing.assert_array_equal(
+        np.asarray(v[: 4 * 6]), np.asarray(img[:, :, 0]).ravel()
+    )
+    np.testing.assert_array_equal(
+        np.asarray(unvectorize(v, (4, 6, 3))), np.asarray(img)
+    )

@@ -1,0 +1,98 @@
+"""Built-in taggers (ops/nlp/tagging.py): trainable averaged perceptron +
+rule-based POS/NER defaults."""
+
+import numpy as np
+
+from keystone_tpu.ops.nlp.external import NER, POSTagger
+from keystone_tpu.ops.nlp.tagging import (
+    PerceptronTaggerEstimator,
+    rule_ner_tag,
+    rule_pos_tag,
+)
+from keystone_tpu.parallel.dataset import Dataset
+
+
+def _toy_corpus():
+    """Deterministic synthetic tagged corpus: DT (NN|JJ NN) VB [RB]."""
+    dts = ["the", "a"]
+    jjs = ["big", "small", "red", "old"]
+    nns = ["dog", "cat", "house", "tree", "car", "bird"]
+    vbs = ["runs", "sits", "falls", "jumps"]
+    rbs = ["quickly", "slowly"]
+    rng = np.random.default_rng(0)
+    sents = []
+    for _ in range(200):
+        toks, tags = [rng.choice(dts)], ["DT"]
+        if rng.random() < 0.5:
+            toks.append(rng.choice(jjs))
+            tags.append("JJ")
+        toks.append(rng.choice(nns))
+        tags.append("NN")
+        toks.append(rng.choice(vbs))
+        tags.append("VB")
+        if rng.random() < 0.5:
+            toks.append(rng.choice(rbs))
+            tags.append("RB")
+        sents.append((toks, tags))
+    return sents
+
+
+def test_perceptron_tagger_learns_toy_grammar():
+    sents = _toy_corpus()
+    train, test = sents[:160], sents[160:]
+    tagger = PerceptronTaggerEstimator(n_iter=5).fit(
+        Dataset.from_items(train)
+    )
+    correct = total = 0
+    for toks, gold in test:
+        pred = [t for _, t in tagger.apply(toks)]
+        correct += sum(p == g for p, g in zip(pred, gold))
+        total += len(gold)
+    assert correct / total > 0.97
+
+    # trained tagger plugs into the POSTagger node as an annotator
+    node = POSTagger(annotator=tagger)
+    toks = ["the", "red", "dog", "runs"]
+    assert [t for _, t in node.apply(toks)] == ["DT", "JJ", "NN", "VB"]
+
+
+def test_rule_pos_tagger_heuristics():
+    tags = rule_pos_tag(
+        ["The", "quick", "dogs", "ran", "slowly", "to", "Paris", "in",
+         "1995"]
+    )
+    assert tags[0] == "DT"
+    assert tags[2] == "NNS"
+    assert tags[4] == "RB"
+    assert tags[5] == "IN"
+    assert tags[6] == "NNP"  # capitalized mid-sentence
+    assert tags[8] == "CD"
+
+
+def test_rule_ner_entities():
+    toks = "Dr . Smith joined Acme Corp in March 2021 with 500 staff".split()
+    labels = rule_ner_tag(toks)
+    assert labels[toks.index("Smith")] == "PERSON"
+    assert labels[toks.index("Acme")] == "ORG"
+    assert labels[toks.index("Corp")] == "ORG"
+    assert labels[toks.index("March")] == "DATE"
+    assert labels[toks.index("2021")] == "DATE"
+    assert labels[toks.index("500")] == "NUMBER"
+    assert labels[toks.index("with")] == "O"
+    # node default path
+    assert NER().apply(toks) == labels
+
+
+def test_corenlp_extractor_ner_replacement_default():
+    from keystone_tpu.ops.nlp.external import CoreNLPFeatureExtractor
+
+    grams = CoreNLPFeatureExtractor(orders=[1]).apply(
+        "he visited Acme Corp today"
+    )
+    flat = [g[0] for g in grams]
+    assert "org" in flat and "acme" not in flat
+    # ner=False disables replacement
+    grams_off = CoreNLPFeatureExtractor(orders=[1], ner=False).apply(
+        "he visited Acme Corp today"
+    )
+    assert "acme" in [g[0] for g in grams_off]
