@@ -31,6 +31,8 @@ from keystone_tpu.ops.learning.kmeans import (
     KMeansPlusPlusEstimator,
 )
 from keystone_tpu.ops.learning.gmm import (
+    FusedGMMEstimator,
+    OptimizableGMMEstimator,
     GaussianMixtureModel,
     GaussianMixtureModelEstimator,
 )
@@ -53,6 +55,11 @@ from keystone_tpu.ops.learning.kernel import (
     KernelRidgeRegression,
 )
 from keystone_tpu.ops.learning.cost import CostModel
+from keystone_tpu.ops.learning.sparse_ell import (
+    EllLeastSquaresEstimator,
+    EllLinearMapper,
+    ell_dataset,
+)
 
 __all__ = [
     "ApproximatePCAEstimator",
@@ -74,8 +81,12 @@ __all__ = [
     "GaussianMixtureModel",
     "GaussianMixtureModelEstimator",
     "KMeansModel",
+    "EllLeastSquaresEstimator",
+    "FusedGMMEstimator",
+    "EllLinearMapper",
     "KMeansPlusPlusEstimator",
     "LeastSquaresDenseGradient",
+    "ell_dataset",
     "LeastSquaresEstimator",
     "LeastSquaresSparseGradient",
     "LinearDiscriminantAnalysis",
@@ -86,6 +97,7 @@ __all__ = [
     "LogisticRegressionEstimator",
     "LogisticRegressionModel",
     "NaiveBayesEstimator",
+    "OptimizableGMMEstimator",
     "NaiveBayesModel",
     "PCAEstimator",
     "PCATransformer",

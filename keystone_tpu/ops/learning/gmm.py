@@ -7,14 +7,23 @@ random init, variance flooring, incremental log-sum-exp cost, min-cluster
 guard). The E/M steps are jitted device matmuls; the reference's
 incremental LSE trick is the standard logsumexp here.
 
-The native enceval-backed variant of the reference
-(nodes/learning/external/GaussianMixtureModelEstimator.scala) maps to this
-same device EM — the "native" path on TPU is XLA itself.
+Two physical EM implementations exist, like the reference's scala/enceval
+pair (nodes/learning/external/GaussianMixtureModelEstimator.scala):
+``GaussianMixtureModelEstimator`` steps EM from the host (one small jitted
+program per iteration, cost read back each step — easy to introspect),
+and ``FusedGMMEstimator`` runs the ENTIRE EM as one ``lax.while_loop``
+program that never leaves the device (convergence test, min-cluster
+guard, and variance flooring all in-graph) — the enceval-native analogue,
+where "native" on TPU means fused XLA. ``OptimizableGMMEstimator`` picks
+between them at k >= 32 the way the reference flips to the native
+implementation for large vocabularies (nodes/images/FisherVector
+.scala:84-94).
 """
 
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Any
 
 import jax
@@ -24,6 +33,7 @@ import numpy as np
 from keystone_tpu.ops.learning.kmeans import KMeansPlusPlusEstimator
 from keystone_tpu.parallel.dataset import Dataset
 from keystone_tpu.workflow.api import Estimator, Transformer
+from keystone_tpu.workflow.node_optimization import Optimizable
 
 KMEANS_PLUS_PLUS_INITIALIZATION = "kmeans++"
 RANDOM_INITIALIZATION = "random"
@@ -128,14 +138,10 @@ class GaussianMixtureModelEstimator(Estimator):
     initialization_method: str = KMEANS_PLUS_PLUS_INITIALIZATION
     seed: int = 0
 
-    def fit(self, data) -> GaussianMixtureModel:
-        if isinstance(data, Dataset):
-            X = np.asarray(data.array(), np.float32)
-        else:
-            X = np.asarray(data, np.float32)
-        X = jnp.asarray(X)
+    def _initialize(self, X, xsq):
+        """Shared init for both physical EMs: k-means++ (or random) seeds
+        + variance floor (GaussianMixtureModelEstimator.scala:60-90)."""
         n, d = X.shape
-        xsq = X * X
         mean_global = jnp.mean(X, axis=0)
         var_global = jnp.mean(xsq, axis=0) - mean_global * mean_global
 
@@ -166,6 +172,17 @@ class GaussianMixtureModelEstimator(Estimator):
             self.absolute_variance_threshold,
         )
         var = jnp.maximum(var, var_lb[None, :])
+        return mu, var, weights, var_lb
+
+    def fit(self, data) -> GaussianMixtureModel:
+        if isinstance(data, Dataset):
+            X = np.asarray(data.array(), np.float32)
+        else:
+            X = np.asarray(data, np.float32)
+        X = jnp.asarray(X)
+        n = X.shape[0]
+        xsq = X * X
+        mu, var, weights, var_lb = self._initialize(X, xsq)
 
         prev_cost = None
         for _ in range(self.max_iterations):
@@ -196,3 +213,127 @@ class GaussianMixtureModelEstimator(Estimator):
         return GaussianMixtureModel(
             mu.T, var.T, weights, self.weight_threshold
         )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "max_iterations", "min_cluster_size", "stop_tolerance",
+        "weight_threshold",
+    ),
+)
+def _fused_em(
+    X, mu0, var0, w0, var_lb, *, max_iterations: int,
+    min_cluster_size: int, stop_tolerance: float, weight_threshold: float,
+):
+    """Whole EM as ONE device program: lax.while_loop with the convergence
+    test, aggressive posterior thresholding, min-cluster guard, and
+    variance flooring all in-graph — zero host syncs until the caller
+    reads the result. Semantics identical to the host-stepped loop in
+    ``GaussianMixtureModelEstimator.fit`` (both break BEFORE applying an
+    update when converged or unbalanced)."""
+    n = X.shape[0]
+    xsq = X * X
+
+    def cond(state):
+        i, mu, var, w, prev_cost, done = state
+        return (i < max_iterations) & ~done
+
+    def body(state):
+        i, mu, var, w, prev_cost, done = state
+        llh = _log_likelihoods_dk(X, mu.T, var.T, w)
+        cost = jnp.mean(jax.scipy.special.logsumexp(llh, axis=1))
+        converged = (cost - prev_cost) < stop_tolerance * jnp.abs(prev_cost)
+
+        q = jnp.exp(llh - jnp.max(llh, axis=1, keepdims=True))
+        q = q / jnp.sum(q, axis=1, keepdims=True)
+        q = jnp.where(q > weight_threshold, q, 0.0)
+        q = q / jnp.sum(q, axis=1, keepdims=True)
+        q_sum = jnp.sum(q, axis=0)
+        unbalanced = jnp.any(q_sum < min_cluster_size)
+
+        stop = converged | unbalanced
+        inv = 1.0 / jnp.maximum(q_sum, 1e-30)
+        hp = jax.lax.Precision.HIGHEST
+        mu_new = inv[:, None] * jnp.matmul(q.T, X, precision=hp)
+        var_new = (
+            inv[:, None] * jnp.matmul(q.T, xsq, precision=hp)
+            - mu_new * mu_new
+        )
+        var_new = jnp.maximum(var_new, var_lb[None, :])
+        w_new = q_sum / n
+
+        keep = lambda new, old: jnp.where(stop, old, new)
+        return (
+            i + 1,
+            keep(mu_new, mu),
+            keep(var_new, var),
+            keep(w_new, w),
+            jnp.where(stop, prev_cost, cost),
+            stop,
+        )
+
+    _, mu, var, w, _, _ = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), mu0, var0, w0, jnp.float32(-jnp.inf),
+         jnp.bool_(False)),
+    )
+    return mu, var, w
+
+
+@dataclasses.dataclass(eq=False)
+class FusedGMMEstimator(GaussianMixtureModelEstimator):
+    """Second physical EM implementation — the enceval-native analogue
+    (reference: nodes/learning/external/GaussianMixtureModelEstimator
+    .scala): the full EM runs as one fused device program. Same init,
+    same parameters, same stopping semantics as the host-stepped EM."""
+
+    def fit(self, data) -> GaussianMixtureModel:
+        if isinstance(data, Dataset):
+            X = np.asarray(data.array(), np.float32)
+        else:
+            X = np.asarray(data, np.float32)
+        X = jnp.asarray(X)
+        mu, var, weights, var_lb = self._initialize(X, X * X)
+        mu, var, weights = _fused_em(
+            X, mu, var, weights, var_lb,
+            max_iterations=self.max_iterations,
+            min_cluster_size=self.min_cluster_size,
+            stop_tolerance=self.stop_tolerance,
+            weight_threshold=self.weight_threshold,
+        )
+        return GaussianMixtureModel(
+            mu.T, var.T, weights, self.weight_threshold
+        )
+
+
+@dataclasses.dataclass(eq=False)
+class OptimizableGMMEstimator(GaussianMixtureModelEstimator, Optimizable):
+    """Physical-choice wrapper: the fused device EM at k >= 32, the
+    host-stepped EM below — mirroring the reference's switch to the
+    native implementation for large vocabularies
+    (nodes/images/FisherVector.scala:84-94)."""
+
+    native_k_threshold: int = 32
+
+    def _chosen(self) -> GaussianMixtureModelEstimator:
+        cls = (
+            FusedGMMEstimator
+            if self.k >= self.native_k_threshold
+            else GaussianMixtureModelEstimator
+        )
+        fields = {
+            f.name: getattr(self, f.name)
+            for f in dataclasses.fields(GaussianMixtureModelEstimator)
+        }
+        return cls(**fields)
+
+    @property
+    def default(self) -> Estimator:
+        return self._chosen()
+
+    def optimize(self, samples, n_total: int) -> Estimator:
+        return self._chosen()
+
+    def fit(self, data) -> GaussianMixtureModel:
+        return self._chosen().fit(data)

@@ -4,6 +4,7 @@ from keystone_tpu.ops.stats.nodes import (
     LinearRectifier,
     NormalizeRows,
     PaddedFFT,
+    RandomFFTFeatures,
     RandomSignNode,
     Sampler,
     SignedHellingerMapper,
@@ -18,6 +19,7 @@ __all__ = [
     "LinearRectifier",
     "NormalizeRows",
     "PaddedFFT",
+    "RandomFFTFeatures",
     "RandomSignNode",
     "Sampler",
     "SignedHellingerMapper",

@@ -13,6 +13,7 @@ mesh's data axis automatically under jit.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Any, Callable, Optional
 
 import jax
@@ -67,6 +68,73 @@ class PaddedFFT(Transformer):
 
     def eq_key(self):
         return ("padded_fft",)
+
+
+@partial(jax.jit, static_argnames=("pad", "thresh"))
+def _fft_bank_chunk(chunk, signs, *, pad: int, thresh: float):
+    """One fused program for a row chunk of RandomFFTFeatures — module
+    level so the jit cache is shared across instances and calls."""
+    f = signs.shape[0]
+    xs = chunk[:, None, :] * signs[None, :, :]
+    spec = jnp.real(jnp.fft.fft(xs, n=pad, axis=-1))[:, :, : pad // 2]
+    return jnp.maximum(spec, thresh).reshape(chunk.shape[0], f * (pad // 2))
+
+
+@dataclasses.dataclass(eq=False)
+class RandomFFTFeatures(Transformer):
+    """All ``num_ffts`` random-sign -> PaddedFFT -> rectify branches of
+    the MnistRandomFFT featurization in ONE jitted program (reference
+    composes per-branch pipelines, MnistRandomFFT.scala:28-37; the math
+    is identical — this is the batched physical plan: one (num_ffts, d)
+    sign matrix, one batched FFT, one reshape, instead of 3 x num_ffts
+    separate dispatches + a concatenate)."""
+
+    signs: Any  # (num_ffts, d)
+    rectify_threshold: float = 0.0
+    row_chunk: int = 8192  # bounds the (chunk, num_ffts, pad) intermediate
+
+    @staticmethod
+    def create(
+        d: int, num_ffts: int, seed: int = 0, rectify_threshold: float = 0.0
+    ) -> "RandomFFTFeatures":
+        """Branch i's signs match ``RandomSignNode.create(d, seed + i)``,
+        so the fused node is numerically interchangeable with the
+        composed per-branch pipelines."""
+        signs = np.stack([
+            np.random.default_rng(seed + i)
+            .integers(0, 2, size=d)
+            .astype(np.float32) * 2.0 - 1.0
+            for i in range(num_ffts)
+        ])
+        return RandomFFTFeatures(
+            jnp.asarray(signs), rectify_threshold=rectify_threshold
+        )
+
+    def _pad_len(self, d: int) -> int:
+        return int(2 ** np.ceil(np.log2(max(d, 1))))
+
+    @property
+    def out_dim(self) -> int:
+        return self.signs.shape[0] * (self._pad_len(self.signs.shape[1]) // 2)
+
+    def apply(self, x):
+        pad = self._pad_len(x.shape[-1])
+        xs = x[None, :] * self.signs  # (num_ffts, d)
+        spec = jnp.real(jnp.fft.fft(xs, n=pad, axis=-1))[:, : pad // 2]
+        return jnp.maximum(spec, self.rectify_threshold).reshape(-1)
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        x = ds.padded()
+        pad = self._pad_len(x.shape[-1])
+        outs = [
+            _fft_bank_chunk(
+                x[s : s + self.row_chunk], self.signs,
+                pad=pad, thresh=self.rectify_threshold,
+            )
+            for s in range(0, x.shape[0], self.row_chunk)
+        ]
+        out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+        return Dataset.from_array(out, n=ds.n)
 
 
 @dataclasses.dataclass(eq=False)

@@ -21,7 +21,12 @@ import numpy as np
 from keystone_tpu.evaluation import MulticlassClassifierEvaluator
 from keystone_tpu.loaders import LabeledData
 from keystone_tpu.ops.learning import BlockLeastSquaresEstimator
-from keystone_tpu.ops.stats import LinearRectifier, PaddedFFT, RandomSignNode
+from keystone_tpu.ops.stats import (
+    LinearRectifier,
+    PaddedFFT,
+    RandomFFTFeatures,
+    RandomSignNode,
+)
 from keystone_tpu.ops.util.nodes import (
     ClassLabelIndicators,
     MaxClassifier,
@@ -42,18 +47,25 @@ class MnistRandomFFTConfig:
     block_size: int = 2048
     lam: float = 0.0
     seed: int = 0
+    fused: bool = True  # one batched program for all branches
+    # (RandomFFTFeatures) vs the reference's literal per-branch gather
 
 
 def build_pipeline(
     train: LabeledData, conf: MnistRandomFFTConfig, d: int = MNIST_DIM
 ) -> Pipeline:
-    branches = [
-        RandomSignNode.create(d, seed=conf.seed + i)
-        .and_then(PaddedFFT())
-        .and_then(LinearRectifier(0.0))
-        for i in range(conf.num_ffts)
-    ]
-    featurizer = Pipeline.gather(branches).and_then(VectorCombiner())
+    if conf.fused:
+        featurizer = RandomFFTFeatures.create(
+            d, conf.num_ffts, seed=conf.seed
+        ).to_pipeline()
+    else:
+        branches = [
+            RandomSignNode.create(d, seed=conf.seed + i)
+            .and_then(PaddedFFT())
+            .and_then(LinearRectifier(0.0))
+            for i in range(conf.num_ffts)
+        ]
+        featurizer = Pipeline.gather(branches).and_then(VectorCombiner())
     labels = ClassLabelIndicators(NUM_CLASSES)(train.labels)
     return featurizer.and_then(
         BlockLeastSquaresEstimator(conf.block_size, num_iter=1, lam=conf.lam),

@@ -68,3 +68,47 @@ def test_gmm_csv_load(tmp_path):
     assert gmm.k == 2 and gmm.dim == 2
     out = gmm.apply(np.array([0.0, 2.0], np.float32))
     assert out.shape == (2,)
+
+
+def test_fused_gmm_matches_host_stepped_em():
+    """The fused lax.while_loop EM (enceval-native analogue) and the
+    host-stepped EM produce the same model from the same init/seed."""
+    from keystone_tpu.ops.learning import (
+        FusedGMMEstimator,
+        GaussianMixtureModelEstimator,
+        OptimizableGMMEstimator,
+    )
+
+    rng = np.random.default_rng(0)
+    centers = np.asarray([[0.0, 0.0], [5.0, 5.0], [-5.0, 5.0]], np.float32)
+    X = np.concatenate([
+        rng.standard_normal((120, 2)).astype(np.float32) * 0.4 + c
+        for c in centers
+    ])
+    kwargs = dict(k=3, max_iterations=30, min_cluster_size=5, seed=1)
+    host = GaussianMixtureModelEstimator(**kwargs).fit(X)
+    fused = FusedGMMEstimator(**kwargs).fit(X)
+    np.testing.assert_allclose(
+        np.asarray(fused.means), np.asarray(host.means), rtol=1e-3,
+        atol=1e-3,
+    )
+    np.testing.assert_allclose(
+        np.asarray(fused.weights), np.asarray(host.weights), atol=1e-3
+    )
+    # both recover the true centers (column layout (d, k))
+    mu = np.sort(np.asarray(fused.means).T, axis=0)
+    np.testing.assert_allclose(mu, np.sort(centers, axis=0), atol=0.3)
+
+
+def test_optimizable_gmm_picks_fused_at_k32():
+    from keystone_tpu.ops.learning import (
+        FusedGMMEstimator,
+        GaussianMixtureModelEstimator,
+        OptimizableGMMEstimator,
+    )
+
+    small = OptimizableGMMEstimator(k=8)
+    big = OptimizableGMMEstimator(k=32)
+    assert type(small.default) is GaussianMixtureModelEstimator
+    assert type(big.default) is FusedGMMEstimator
+    assert type(big.optimize([], -1)) is FusedGMMEstimator

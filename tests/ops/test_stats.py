@@ -103,3 +103,36 @@ def test_column_sampler_and_sampler():
     assert all(np.asarray(m).shape == (4, 5) for m in out.items())
     ds = Sampler(10, seed=0).apply(np.arange(100.0).reshape(50, 2))
     assert ds.n == 10
+
+
+def test_random_fft_features_matches_composed_branches():
+    """Fused RandomFFTFeatures == gather of RandomSignNode -> PaddedFFT ->
+    LinearRectifier branches, feature for feature."""
+    from keystone_tpu.ops.stats import (
+        LinearRectifier, PaddedFFT, RandomFFTFeatures, RandomSignNode,
+    )
+
+    rng = np.random.default_rng(0)
+    d, f, n = 100, 3, 17
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    ds = Dataset.from_array(jnp.asarray(x))
+
+    fused = RandomFFTFeatures.create(d, f, seed=5)
+    got = np.asarray(fused.apply_batch(ds).padded())
+
+    parts = []
+    for i in range(f):
+        b = LinearRectifier(0.0).apply_batch(
+            PaddedFFT().apply_batch(
+                RandomSignNode.create(d, seed=5 + i).apply_batch(ds)
+            )
+        )
+        parts.append(np.asarray(b.padded()))
+    want = np.concatenate(parts, axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    assert fused.out_dim == want.shape[1]
+    # single-example apply agrees with the batch path
+    np.testing.assert_allclose(
+        np.asarray(fused.apply(jnp.asarray(x[0]))), want[0],
+        rtol=1e-5, atol=1e-5,
+    )
