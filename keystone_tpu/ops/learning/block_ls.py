@@ -230,6 +230,8 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     # logging in the reference driver loop)
 
     def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
+        if self.solve not in ("device", "host"):
+            raise ValueError(f"solve must be 'device' or 'host', got {self.solve!r}")
         # Mean-centering of features and labels (reference fits
         # StandardScaler(normalizeStdDev=false) per block + labels:
         # BlockLinearMapper.scala:209-215; full-width centering is

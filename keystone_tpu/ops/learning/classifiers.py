@@ -20,7 +20,7 @@ import numpy as np
 import scipy.linalg
 from jax.experimental import sparse as jsparse
 
-from keystone_tpu.ops.learning.lbfgs import run_lbfgs
+from keystone_tpu.ops.learning.lbfgs import run_lbfgs, run_lbfgs_device
 from keystone_tpu.parallel.dataset import Dataset
 from keystone_tpu.workflow.api import LabelEstimator, Transformer
 
@@ -88,6 +88,31 @@ def _pad_rows(a: jnp.ndarray, n: int) -> jnp.ndarray:
     )
 
 
+def _logistic_vg(W, x, onehot, mask, n, reg):
+    """Softmax cross-entropy mean loss + L2 and its gradient — the
+    traceable ``vg(W, *data)`` the fused device L-BFGS consumes (module
+    level so the compiled optimizer is cached across fits)."""
+    if isinstance(x, jsparse.BCOO):
+        logits = jsparse.bcoo_dot_general(
+            x, W, dimension_numbers=(([1], [0]), ([], []))
+        )
+    else:
+        logits = x @ W
+    logz = jax.scipy.special.logsumexp(logits, axis=1)
+    ll = jnp.sum((logz - jnp.sum(logits * onehot, axis=1)) * mask)
+    p = jnp.exp(logits - logz[:, None]) * mask[:, None]
+    if isinstance(x, jsparse.BCOO):
+        g = jsparse.bcoo_dot_general(
+            x, p - onehot, dimension_numbers=(([0], [0]), ([], []))
+        )
+    else:
+        g = x.T @ (p - onehot)
+    return ll / n + 0.5 * reg * jnp.sum(W * W), g / n + reg * W
+
+
+_jit_logistic_vg = jax.jit(_logistic_vg)
+
+
 @dataclasses.dataclass(eq=False)
 class LogisticRegressionModel(Transformer):
     """argmax-of-logits classifier (reference:
@@ -114,14 +139,18 @@ class LogisticRegressionEstimator(LabelEstimator):
     """Multinomial logistic regression by full-batch L-BFGS (reference:
     LogisticRegressionModel.scala:42 — MLlib LogisticRegressionWithLBFGS +
     SquaredL2Updater). Softmax cross-entropy gradient is one jitted sharded
-    program; the L-BFGS driver is the shared host implementation."""
+    program; the optimizer is the fused device L-BFGS by default
+    (run_lbfgs_device — zero host syncs), or the f64 host driver."""
 
     num_classes: int
     num_iters: int = 20
     reg_param: float = 0.0
     convergence_tol: float = 1e-4
+    driver: str = "device"
 
     def fit(self, data: Dataset, labels: Dataset) -> LogisticRegressionModel:
+        if self.driver not in ("device", "host"):
+            raise ValueError(f"driver must be 'device' or 'host', got {self.driver!r}")
         y = np.asarray(labels.array()).reshape(-1).astype(np.int64)
         data = data.to_array_mode()
         x = data.padded()
@@ -132,35 +161,23 @@ class LogisticRegressionEstimator(LabelEstimator):
             jnp.asarray(np.eye(k, dtype=np.float32)[y]), x.shape[0]
         ))
         mask = data.mask()
-        is_sparse = isinstance(x, jsparse.BCOO)
 
-        @jax.jit
-        def device_vg(W):
-            if is_sparse:
-                logits = jsparse.bcoo_dot_general(
-                    x, W, dimension_numbers=(([1], [0]), ([], []))
-                )
-            else:
-                logits = x @ W
-            logz = jax.scipy.special.logsumexp(logits, axis=1)
-            ll = jnp.sum(
-                (logz - jnp.sum(logits * onehot, axis=1)) * mask
+        if self.driver == "device":
+            W = run_lbfgs_device(
+                _logistic_vg,  # module-level: jit cache shared across fits
+                jnp.zeros((d, k), jnp.float32),
+                self.num_iters, convergence_tol=self.convergence_tol,
+                data=(x, onehot, mask, jnp.float32(n),
+                      jnp.float32(self.reg_param)),
             )
-            p = jnp.exp(logits - logz[:, None]) * mask[:, None]
-            if is_sparse:
-                g = jsparse.bcoo_dot_general(
-                    x, p - onehot, dimension_numbers=(([0], [0]), ([], []))
-                )
-            else:
-                g = x.T @ (p - onehot)
-            return (
-                ll / n + 0.5 * self.reg_param * jnp.sum(W * W),
-                g / n + self.reg_param * W,
-            )
+            return LogisticRegressionModel(W)
 
         def vg(w_flat):
             W = jnp.asarray(w_flat.reshape(d, k).astype(np.float32))
-            f, g = device_vg(W)
+            f, g = _jit_logistic_vg(
+                W, x, onehot, mask, jnp.float32(n),
+                jnp.float32(self.reg_param),
+            )
             return float(f), np.asarray(g, np.float64).ravel()
 
         w = run_lbfgs(

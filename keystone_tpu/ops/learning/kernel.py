@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from keystone_tpu.ops.learning.block_ls import _f32_mm
+from keystone_tpu.ops.learning.block_ls import _f32_mm, _psd_solve_device
 from keystone_tpu.ops.learning.hostsolve import psd_solve_host
 from keystone_tpu.parallel.dataset import Dataset
 from keystone_tpu.utils.checkpoint import (
@@ -179,6 +179,26 @@ def _krr_update_model(W, Wb_new, start, *, width):
     return jax.lax.dynamic_update_slice_in_dim(W, Wb_new, start, axis=0)
 
 
+@partial(jax.jit, static_argnames=("width",), donate_argnums=(4,))
+def _krr_block_step(X, X_norms, gamma, mask, W, Y, start, lam, *, width):
+    """One whole Gauss-Seidel block update as a single device program:
+    materialize K(:, B), form the residual rhs, solve (K_BB + λI) on
+    device (f32 Cholesky + refinement, block_ls._psd_solve_device), and
+    scatter the block model — the reference's materialize → treeReduce →
+    driver-solve → broadcast round trip (KernelRidgeRegression.scala:
+    86-235) with zero host synchronization."""
+    K_block = _rbf_block.__wrapped__(
+        X, X_norms, gamma, mask, start, width=width
+    )
+    resid = _f32_mm(K_block.T, W)
+    K_bb = jax.lax.dynamic_slice_in_dim(K_block, start, width, axis=0)
+    Wb_old = jax.lax.dynamic_slice_in_dim(W, start, width, axis=0)
+    y_b = jax.lax.dynamic_slice_in_dim(Y, start, width, axis=0)
+    rhs = y_b - (resid - _f32_mm(K_bb.T, Wb_old))
+    Wb_new = _psd_solve_device(K_bb, rhs, lam)
+    return jax.lax.dynamic_update_slice_in_dim(W, Wb_new, start, axis=0)
+
+
 @dataclasses.dataclass(eq=False)
 class KernelBlockLinearMapper(Transformer):
     """Test-time apply: accumulate K_test(:, B) · W_B over blocks
@@ -220,6 +240,10 @@ class KernelRidgeRegression(LabelEstimator):
     block_size: int
     num_epochs: int
     block_permuter: Optional[int] = None
+    solve: str = "device"  # "device": f32 Cholesky + iterative refinement
+    # in the dispatch stream (same discipline as BlockLS — a host solve
+    # costs a ~100 ms sync per block through a remote-dispatch link) |
+    # "host": f64 LAPACK per block for pathological conditioning
     checkpoint_path: Optional[str] = None  # periodic model snapshot every
     # ``checkpoint_every`` block solves; a re-run with the same path
     # resumes at the last completed block (reference checkpoints lineage
@@ -244,6 +268,8 @@ class KernelRidgeRegression(LabelEstimator):
         return order
 
     def fit(self, data: Dataset, labels: Dataset) -> KernelBlockLinearMapper:
+        if self.solve not in ("device", "host"):
+            raise ValueError(f"solve must be 'device' or 'host', got {self.solve!r}")
         data = data.to_array_mode()
         labels = labels.to_array_mode()
         transformer = self.kernel_generator.fit(data)
@@ -268,6 +294,7 @@ class KernelRidgeRegression(LabelEstimator):
                 f"krr bs={self.block_size} ep={self.num_epochs} "
                 f"lam={self.lam} gamma={self.kernel_generator.gamma} "
                 f"perm={self.block_permuter} n={n} n_pad={n_pad} k={k} "
+                f"solve={self.solve} "
                 f"probe={float(jnp.sum(X[0])):.6e}/"
                 f"{float(jnp.sum(Y[0])):.6e}"
             )
@@ -288,18 +315,27 @@ class KernelRidgeRegression(LabelEstimator):
                 order = self._epoch_order(epoch, len(blocks))
                 order_epoch = epoch
             s, wd = blocks[order[pos]]
-            K_block = transformer.train_block(s, wd)  # (n_pad, b)
-            resid, K_bb = _krr_residual(K_block, W, s, width=wd)
-            Wb_old = jax.lax.dynamic_slice_in_dim(W, s, wd, axis=0)
-            y_b = jax.lax.dynamic_slice_in_dim(Y, s, wd, axis=0)
-            rhs = y_b - (resid - _f32_mm(K_bb.T, Wb_old))
-            # pad rows inside the block: K_bb row/col is zero there,
-            # λI makes the system nonsingular and W stays 0 via rhs=0
-            Wb_new = jnp.asarray(
-                psd_solve_host(K_bb, np.asarray(rhs), self.lam),
-                jnp.float32,
-            )
-            W = _krr_update_model(W, Wb_new, s, width=wd)
+            if self.solve == "device":
+                # whole block update — kernel block, residual, solve,
+                # model scatter — stays in the async dispatch stream
+                W = _krr_block_step(
+                    transformer.train_X, transformer._norms,
+                    transformer.gamma, transformer.train_mask,
+                    W, Y, s, self.lam, width=wd,
+                )
+            else:
+                K_block = transformer.train_block(s, wd)  # (n_pad, b)
+                resid, K_bb = _krr_residual(K_block, W, s, width=wd)
+                Wb_old = jax.lax.dynamic_slice_in_dim(W, s, wd, axis=0)
+                y_b = jax.lax.dynamic_slice_in_dim(Y, s, wd, axis=0)
+                rhs = y_b - (resid - _f32_mm(K_bb.T, Wb_old))
+                # pad rows inside the block: K_bb row/col is zero there,
+                # λI makes the system nonsingular, W stays 0 via rhs=0
+                Wb_new = jnp.asarray(
+                    psd_solve_host(K_bb, np.asarray(rhs), self.lam),
+                    jnp.float32,
+                )
+                W = _krr_update_model(W, Wb_new, s, width=wd)
             done += 1
             if ckpt is not None:
                 ckpt.tick(lambda: {

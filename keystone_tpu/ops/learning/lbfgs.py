@@ -6,14 +6,18 @@ master; nodes/learning/Gradient.scala for the least-squares gradients.
 
 TPU-native split: the O(n·d·k) value-and-gradient is ONE jitted program
 over the sharded feature matrix (per-shard MXU matmuls + psum over "data"
-— the treeReduce); the O(m·d·k) two-loop L-BFGS direction update and
-backtracking line search run on host in f64 (the Breeze-driver
-equivalent), keeping the history in host memory instead of HBM.
+— the treeReduce). Two optimizer drivers: the default fused device
+driver (``run_lbfgs_device`` — the ENTIRE optimization, two-loop
+recursion + Armijo line search + convergence test, is one
+``lax.while_loop`` program with zero host syncs), and the f64 host
+driver (``run_lbfgs``, the Breeze-driver equivalent) for problems that
+need double-precision history.
 """
 
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -30,10 +34,32 @@ from keystone_tpu.workflow.api import LabelEstimator
 
 class Gradient:
     """loss(W; A, b) total + gradient over a batch (reference:
-    nodes/learning/Gradient.scala:10)."""
+    nodes/learning/Gradient.scala:10).
+
+    Gradients are stateless, so equality/hash are type-based — this makes
+    ``regularized_vg`` bound methods from different instances of the same
+    gradient class hit the same jit cache entry in the fused driver
+    (fresh estimators per fit would otherwise recompile the optimizer).
+    """
+
+    def __eq__(self, other):
+        return type(self) is type(other)
+
+    def __hash__(self):
+        return hash(type(self))
 
     def value_and_grad(self, A, b, W) -> Tuple[jnp.ndarray, jnp.ndarray]:
         raise NotImplementedError
+
+    def regularized_vg(self, W, A, b, reg, n):
+        """Mean loss + L2, in the ``vg(W, *data)`` shape the fused device
+        driver consumes (bound method: stable jit cache key per gradient
+        instance)."""
+        loss, g = self.value_and_grad(A, b, W)
+        return (
+            loss / n + 0.5 * reg * jnp.sum(W * W),
+            g / n + reg * W,
+        )
 
 
 class LeastSquaresDenseGradient(Gradient):
@@ -70,6 +96,141 @@ class LeastSquaresSparseGradient(Gradient):
             A, res, dimension_numbers=(([0], [0]), ([], []))
         )
         return loss, grad
+
+
+def run_lbfgs_device(
+    device_vg: Callable[..., Tuple[jnp.ndarray, jnp.ndarray]],
+    w0: jnp.ndarray,
+    num_iterations: int,
+    num_corrections: int = 10,
+    convergence_tol: float = 1e-4,
+    data: tuple = (),
+) -> jnp.ndarray:
+    """The ENTIRE L-BFGS optimization as one device program: two-loop
+    recursion over a ring-buffered (m, ...) history, Armijo backtracking
+    via ``lax.while_loop``, convergence test in-graph. Zero host syncs —
+    where the host driver (``run_lbfgs``) pays a dispatch round trip per
+    line-search trial, this pays one per *fit*. f32 on device (the host
+    driver is the f64 fallback for ill-conditioned problems).
+
+    ``device_vg``: traceable ``(W, *data) -> (loss, grad)`` with ``W``
+    in its natural (d, k) shape. It is a STATIC jit argument — pass a
+    module-level function or bound method (not a fresh lambda) with the
+    arrays in ``data``, or every call re-traces and re-compiles the
+    whole nested-loop program (~70 s of XLA compile measured).
+    """
+    return _lbfgs_device_run(
+        device_vg, num_iterations, num_corrections,
+        jnp.float32(convergence_tol), jnp.asarray(w0, jnp.float32), *data
+    )
+
+
+@partial(
+    jax.jit, static_argnames=("device_vg", "num_iterations", "m")
+)
+def _lbfgs_device_run(
+    device_vg, num_iterations: int, m: int, convergence_tol, w0, *data
+):
+    shape = w0.shape
+
+    def dot(a, b):
+        return jnp.sum(a * b)
+
+    def vg(w):
+        return device_vg(w, *data)
+
+    f0, g0 = vg(w0)
+    S = jnp.zeros((m,) + shape, jnp.float32)
+    Y = jnp.zeros((m,) + shape, jnp.float32)
+
+    def cond(st):
+        it, w, f, g, S, Y, count, done = st
+        return (it < num_iterations) & ~done
+
+    def body(st):
+        it, w, f, g, S, Y, count, done = st
+        n_hist = jnp.minimum(count, m)
+
+        # two-loop recursion (ring buffer, newest first)
+        def loop1(i, carry):
+            q, alphas = carry
+            j = (count - 1 - i) % m
+            valid = i < n_hist
+            s, y = S[j], Y[j]
+            rho = 1.0 / jnp.where(valid, dot(y, s), 1.0)
+            a = jnp.where(valid, rho * dot(s, q), 0.0)
+            return q - a * y, alphas.at[i].set(a)
+
+        q, alphas = jax.lax.fori_loop(
+            0, m, loop1, (g, jnp.zeros((m,), jnp.float32))
+        )
+        jl = (count - 1) % m
+        gamma = jnp.where(
+            count > 0,
+            dot(S[jl], Y[jl]) / jnp.maximum(dot(Y[jl], Y[jl]), 1e-30),
+            1.0,
+        )
+        q = q * gamma
+
+        def loop2(i2, q):
+            i = m - 1 - i2
+            j = (count - 1 - i) % m
+            valid = i < n_hist
+            s, y = S[j], Y[j]
+            rho = 1.0 / jnp.where(valid, dot(y, s), 1.0)
+            b = jnp.where(valid, rho * dot(y, q), 0.0)
+            return q + (alphas[i] - b) * s
+
+        q = jax.lax.fori_loop(0, m, loop2, q)
+
+        direction = -q
+        dg = dot(direction, g)
+        bad = dg >= 0
+        direction = jnp.where(bad, -g, direction)
+        dg = jnp.where(bad, -dot(g, g), dg)
+
+        # Armijo backtracking: state carries the step to try next
+        def ls_cond(ls):
+            step, f_t, g_t, w_t, ok, tries = ls
+            return ~ok & (tries < 30)
+
+        def ls_body(ls):
+            step, _, _, _, _, tries = ls
+            w_try = w + step * direction
+            f_try, g_try = vg(w_try)
+            ok = f_try <= f + 1e-4 * step * dg
+            return (
+                jnp.where(ok, step, step * 0.5),
+                f_try, g_try, w_try, ok, tries + 1,
+            )
+
+        _, f_new, g_new, w_new, ok, _ = jax.lax.while_loop(
+            ls_cond, ls_body,
+            (jnp.float32(1.0), f, g, w, jnp.bool_(False), 0),
+        )
+
+        s_vec = w_new - w
+        y_vec = g_new - g
+        store = ok & (dot(s_vec, y_vec) > 1e-10)
+        j = count % m
+        S = jnp.where(store, S.at[j].set(s_vec), S)
+        Y = jnp.where(store, Y.at[j].set(y_vec), Y)
+        count = count + jnp.where(store, 1, 0)
+
+        improvement = jnp.abs(f - f_new) / jnp.maximum(
+            jnp.maximum(jnp.abs(f), jnp.abs(f_new)), 1.0
+        )
+        done = ~ok | (improvement < convergence_tol)
+        keep = lambda new, old: jnp.where(ok, new, old)
+        return (
+            it + 1, keep(w_new, w), keep(f_new, f), keep(g_new, g),
+            S, Y, count, done,
+        )
+
+    st = (jnp.int32(0), w0, f0, g0, S, Y, jnp.int32(0),
+          jnp.bool_(False))
+    _, w, _, _, _, _, _, _ = jax.lax.while_loop(cond, body, st)
+    return w
 
 
 def run_lbfgs(
@@ -148,8 +309,13 @@ class LBFGSwithL2(LabelEstimator, CostModel):
     num_iterations: int = 20
     reg_param: float = 0.0
     sparse: bool = False
+    driver: str = "device"  # "device": whole optimization fused in one
+    # program, zero host syncs (run_lbfgs_device) | "host": f64 Breeze-
+    # driver equivalent, one device round trip per line-search trial
 
     def fit(self, data: Dataset, labels: Dataset):
+        if self.driver not in ("device", "host"):
+            raise ValueError(f"driver must be 'device' or 'host', got {self.driver!r}")
         data = data.to_array_mode()
         labels = labels.to_array_mode()
         A = data.padded()
@@ -178,21 +344,31 @@ class LBFGSwithL2(LabelEstimator, CostModel):
                 g / n + self.reg_param * W,
             )
 
-        def vg(w_flat: np.ndarray):
-            W = jnp.asarray(
-                w_flat.reshape(d, k).astype(np.float32)
+        if self.driver == "device":
+            W = run_lbfgs_device(
+                self.gradient.regularized_vg,  # bound method: stable key
+                jnp.zeros((d, k), jnp.float32),
+                self.num_iterations,
+                self.num_corrections,
+                self.convergence_tol,
+                data=(A, b, jnp.float32(self.reg_param), jnp.float32(n)),
             )
-            loss, g = device_vg(A, b, W)
-            return float(loss), np.asarray(g, np.float64).ravel()
+        else:
+            def vg(w_flat: np.ndarray):
+                W = jnp.asarray(
+                    w_flat.reshape(d, k).astype(np.float32)
+                )
+                loss, g = device_vg(A, b, W)
+                return float(loss), np.asarray(g, np.float64).ravel()
 
-        w = run_lbfgs(
-            vg,
-            np.zeros((d, k)),
-            self.num_iterations,
-            self.num_corrections,
-            self.convergence_tol,
-        )
-        W = jnp.asarray(w.reshape(d, k).astype(np.float32))
+            w = run_lbfgs(
+                vg,
+                np.zeros((d, k)),
+                self.num_iterations,
+                self.num_corrections,
+                self.convergence_tol,
+            )
+            W = jnp.asarray(w.reshape(d, k).astype(np.float32))
         if is_sparse:
             return SparseLinearMapper(W)
         if self.fit_intercept:

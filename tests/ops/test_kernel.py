@@ -1,6 +1,7 @@
 """Kernel ridge tests (reference: KernelModelSuite — block solve vs exact
 dual solution)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -112,3 +113,21 @@ def test_krr_block_permutation_still_converges(mesh8):
     K = _rbf(X, X, 0.5).astype(np.float64)
     W_exact = np.linalg.solve(K + 2.0 * np.eye(n), Y.astype(np.float64))
     np.testing.assert_allclose(np.asarray(model.model)[:n], W_exact, atol=1e-2)
+
+
+def test_krr_device_solve_matches_host_solve():
+    import dataclasses as dc
+
+    rng = np.random.default_rng(9)
+    n, d, k = 96, 6, 2
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    Y = rng.standard_normal((n, k)).astype(np.float32)
+    Xd = Dataset.from_array(jnp.asarray(X))
+    Yd = Dataset.from_array(jnp.asarray(Y))
+    base = KernelRidgeRegression(
+        GaussianKernelGenerator(gamma=0.1), lam=0.4, block_size=32,
+        num_epochs=2,
+    )
+    W_dev = np.asarray(dc.replace(base, solve="device").fit(Xd, Yd).model)
+    W_host = np.asarray(dc.replace(base, solve="host").fit(Xd, Yd).model)
+    np.testing.assert_allclose(W_dev, W_host, rtol=5e-4, atol=5e-5)

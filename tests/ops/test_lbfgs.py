@@ -1,5 +1,6 @@
 """L-BFGS tests (reference: LBFGSSuite — distributed vs local solutions)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -54,3 +55,44 @@ def test_sparse_lbfgs_runs(mesh8):
 
 def test_lbfgs_weight():
     assert DenseLBFGSwithL2(num_iterations=20).weight == 21
+
+
+def test_device_lbfgs_matches_host_driver_least_squares():
+    import dataclasses as dc
+
+    rng = np.random.default_rng(11)
+    n, d, k = 400, 24, 3
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    W_true = rng.standard_normal((d, k)).astype(np.float32)
+    Y = X @ W_true
+    Xd = Dataset.from_array(jnp.asarray(X))
+    Yd = Dataset.from_array(jnp.asarray(Y))
+    base = DenseLBFGSwithL2(reg_param=1e-4, num_iterations=40,
+                            fit_intercept=False)
+    m_dev = dc.replace(base, driver="device").fit(Xd, Yd)
+    m_host = dc.replace(base, driver="host").fit(Xd, Yd)
+    # both recover the generating model; drivers agree to optimizer noise
+    assert np.abs(np.asarray(m_dev.W) - W_true).max() < 5e-2
+    assert np.abs(np.asarray(m_dev.W) - np.asarray(m_host.W)).max() < 5e-2
+
+
+def test_device_lbfgs_logistic_regression_learns():
+    from keystone_tpu.ops.learning import LogisticRegressionEstimator
+
+    rng = np.random.default_rng(12)
+    n, d, k = 600, 10, 3
+    centers = rng.standard_normal((k, d)).astype(np.float32) * 3
+    y = rng.integers(0, k, n).astype(np.int32)
+    X = centers[y] + rng.standard_normal((n, d)).astype(np.float32)
+    Xd = Dataset.from_array(jnp.asarray(X))
+    yd = Dataset.from_array(jnp.asarray(y))
+    model = LogisticRegressionEstimator(
+        num_classes=k, num_iters=30, driver="device"
+    ).fit(Xd, yd)
+    preds = np.asarray(model.apply_batch(Xd).padded())
+    assert (preds == y).mean() > 0.9
+    host = LogisticRegressionEstimator(
+        num_classes=k, num_iters=30, driver="host"
+    ).fit(Xd, yd)
+    hp = np.asarray(host.apply_batch(Xd).padded())
+    assert (preds == hp).mean() > 0.95
