@@ -29,7 +29,13 @@ def _load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_LIB_PATH) and os.path.exists(
+    stale = os.path.exists(_LIB_PATH) and any(
+        os.path.getmtime(os.path.join(_NATIVE_DIR, f))
+        > os.path.getmtime(_LIB_PATH)
+        for f in os.listdir(_NATIVE_DIR)
+        if f.endswith(".cc")
+    )
+    if (not os.path.exists(_LIB_PATH) or stale) and os.path.exists(
         os.path.join(_NATIVE_DIR, "Makefile")
     ):
         try:
@@ -40,7 +46,10 @@ def _load() -> Optional[ctypes.CDLL]:
                 timeout=120,
             )
         except Exception:
-            return None
+            if not os.path.exists(_LIB_PATH):
+                return None
+            # rebuild failed but a previously built library exists: load
+            # it — missing newer symbols are guarded per-function
     if not os.path.exists(_LIB_PATH):
         return None
     try:
@@ -70,6 +79,24 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_int,
     ]
     lib.cifar_read.restype = ctypes.c_int64
+    if not hasattr(lib, "text_ngram_hash_tf"):
+        _lib = lib  # stale build without text.cc: IO still usable
+        return _lib
+    lib.text_ngram_hash_tf.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+        ctypes.c_int,
+    ]
+    lib.text_ngram_hash_tf.restype = ctypes.c_int64
     _lib = lib
     return _lib
 
@@ -106,6 +133,55 @@ def read_csv_f32(
             path, delimiter=delimiter, dtype=np.float32, ndmin=2
         )
     return out
+
+
+def text_ngram_hash_tf(
+    docs,
+    min_order: int,
+    max_order: int,
+    num_features: int,
+    binarize: bool = False,
+    num_threads: int = 0,
+):
+    """Fused trim/lowercase/tokenize/rolling-ngram-hash TF over a list of
+    ASCII document strings (native/text.cc). Returns ``(row_ptr int64
+    (n+1,), cols int32 (nnz,), vals float32 (nnz,))`` with per-document
+    columns ascending — hash-identical to composing Trim -> LowerCase ->
+    Tokenizer -> NGramsHashingTF. Returns None (caller falls back to the
+    Python nodes) when the library is unavailable or any doc is
+    non-ASCII (C++ tokenization is byte-level)."""
+    if num_features <= 0:  # C-side modulo-by-zero would SIGFPE
+        raise ValueError(f"num_features must be positive: {num_features}")
+    lib = _load()
+    if lib is None or not hasattr(lib, "text_ngram_hash_tf"):
+        return None
+    try:
+        blobs = [d.encode("ascii") for d in docs]
+    except UnicodeEncodeError:
+        return None
+    n = len(blobs)
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum([len(b) for b in blobs], out=offsets[1:])
+    concat = b"".join(blobs)
+    row_ptr = np.zeros(n + 1, np.int64)
+    cap = max(2 * len(concat) + 16, 1024)
+    for _ in range(2):
+        cols = np.empty(cap, np.int32)
+        vals = np.empty(cap, np.float32)
+        nnz = lib.text_ngram_hash_tf(
+            concat,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, min_order, max_order, num_features, int(binarize),
+            row_ptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            cap,
+            num_threads or (os.cpu_count() or 1),
+        )
+        if nnz >= 0:
+            return row_ptr, cols[:nnz], vals[:nnz]
+        cap = int(row_ptr[n])  # exact requirement, filled before -1
+    return None
 
 
 def read_cifar(

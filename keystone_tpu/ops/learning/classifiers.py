@@ -152,6 +152,13 @@ class LogisticRegressionEstimator(LabelEstimator):
         if self.driver not in ("device", "host"):
             raise ValueError(f"driver must be 'device' or 'host', got {self.driver!r}")
         y = np.asarray(labels.array()).reshape(-1).astype(np.int64)
+        if y.size and (y.min() < 0 or y.max() >= self.num_classes):
+            # np.eye(k)[y] would silently wrap negatives (e.g. -1/+1
+            # binary labels) into valid classes and corrupt the fit
+            raise ValueError(
+                f"labels must be class ids in [0, {self.num_classes}); "
+                f"got range [{y.min()}, {y.max()}]"
+            )
         data = data.to_array_mode()
         x = data.padded()
         n = data.n

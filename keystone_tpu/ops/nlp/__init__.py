@@ -4,7 +4,11 @@ from keystone_tpu.ops.nlp.ngrams import (
     NGramsCounts,
     NGramsFeaturizer,
 )
-from keystone_tpu.ops.nlp.hashing_tf import HashingTF, NGramsHashingTF
+from keystone_tpu.ops.nlp.hashing_tf import (
+    FusedTextHashTF,
+    HashingTF,
+    NGramsHashingTF,
+)
 from keystone_tpu.ops.nlp.word_frequency import (
     WordFrequencyEncoder,
     WordFrequencyTransformer,
@@ -18,6 +22,7 @@ from keystone_tpu.ops.nlp.stupid_backoff import (
 )
 
 __all__ = [
+    "FusedTextHashTF",
     "HashingTF",
     "LowerCase",
     "NGram",

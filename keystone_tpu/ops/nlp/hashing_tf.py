@@ -89,6 +89,79 @@ class HashingTF(Transformer):
 
 
 @dataclasses.dataclass(eq=False)
+class FusedTextHashTF(Transformer):
+    """raw document string -> hashed n-gram TF sparse row, with the whole
+    Trim -> LowerCase -> Tokenizer -> NGramsHashingTF chain fused into one
+    multi-threaded pass of the native C++ runtime (native/text.cc) —
+    hash-identical output, no per-token Python objects. Falls back to the
+    composed Python nodes when the library is unavailable or a document
+    is non-ASCII. ``binarize`` maps counts to 1 (TermFrequency(x => 1))."""
+
+    orders: Sequence[int]
+    num_features: int
+    binarize: bool = False
+    vmap_batch = False
+
+    def __post_init__(self):
+        self._delegate = NGramsHashingTF(self.orders, self.num_features)
+        if self.num_features <= 0:
+            raise ValueError(
+                f"num_features must be positive, got {self.num_features}"
+            )
+        self._lo = self._delegate._lo
+        self._hi = self._delegate._hi
+
+    def _python_fallback(self, docs) -> Dataset:
+        from keystone_tpu.ops.nlp.string_utils import (
+            LowerCase, Tokenizer, Trim,
+        )
+
+        tok, lc, tr = Tokenizer(), LowerCase(), Trim()
+        token_ds = Dataset.from_items(
+            [tok.apply(lc.apply(tr.apply(d))) for d in docs]
+        )
+        out = self._delegate.apply_batch(token_ds)
+        if self.binarize:
+            mat = out.padded()
+            out = Dataset.from_array(
+                jsparse.BCOO(
+                    (jnp.minimum(mat.data, 1.0), mat.indices),
+                    shape=mat.shape,
+                ),
+                n=out.n,
+            )
+        return out
+
+    def apply(self, doc: str) -> jsparse.BCOO:
+        mat = self.apply_batch(Dataset.from_items([doc])).padded()
+        idx = np.asarray(mat.indices)
+        return jsparse.BCOO(
+            (jnp.asarray(mat.data), jnp.asarray(idx[:, 1:2])),
+            shape=(self.num_features,),
+        )
+
+    def apply_batch(self, ds: Dataset) -> Dataset:
+        from keystone_tpu import native
+
+        items = ds.items()
+        out = native.text_ngram_hash_tf(
+            items, self._lo, self._hi, self.num_features, self.binarize
+        )
+        if out is None:
+            return self._python_fallback(items)
+        row_ptr, cols, values = out
+        rows = np.repeat(
+            np.arange(len(items), dtype=np.int32), np.diff(row_ptr)
+        )
+        indices = np.stack([rows, cols], axis=1)
+        mat = jsparse.BCOO(
+            (jnp.asarray(values), jnp.asarray(indices)),
+            shape=(len(items), self.num_features),
+        )
+        return Dataset.from_array(mat, n=len(items))
+
+
+@dataclasses.dataclass(eq=False)
 class NGramsHashingTF(Transformer):
     """Rolling-hash n-gram TF: hashes every ngram of the given consecutive
     orders without materializing them (reference:

@@ -21,6 +21,7 @@ from keystone_tpu.ops.learning.classifiers import (
     LogisticRegressionEstimator,
 )
 from keystone_tpu.ops.nlp import (
+    FusedTextHashTF,
     LowerCase,
     NGramsFeaturizer,
     Tokenizer,
@@ -39,9 +40,24 @@ class AmazonReviewsConfig:
     n_grams: int = 2
     common_features: int = 100_000
     num_iters: int = 20
+    hashing: bool = False  # hashed n-gram features via the fused native
+    # C++ featurizer (FusedTextHashTF) instead of the string-keyed
+    # NGramsFeaturizer -> CommonSparseFeatures chain — same binarized
+    # n-gram model family (reference ships HashingTF as the alternative:
+    # nodes/nlp/HashingTF.scala), one multi-threaded pass per batch
 
 
 def build_pipeline(train: LabeledData, conf: AmazonReviewsConfig) -> Pipeline:
+    if conf.hashing:
+        featurizer = FusedTextHashTF(
+            range(1, conf.n_grams + 1), conf.common_features,
+            binarize=True,
+        ).to_pipeline()
+        return featurizer.and_then(
+            LogisticRegressionEstimator(2, num_iters=conf.num_iters),
+            train.data,
+            train.labels,
+        )
     featurizer = (
         Trim()
         .and_then(LowerCase())

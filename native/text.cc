@@ -1,0 +1,135 @@
+// keystone-tpu native text featurization.
+//
+// The reference's text featurization chain (Trim -> LowerCase ->
+// Tokenizer -> NGrams(HashingTF), nodes/nlp/*.scala) runs on the JVM per
+// partition; here the equivalent host-side hot path is one fused
+// multi-threaded C++ pass per document batch: trim + ASCII lowercase +
+// tokenize on non-word bytes + FNV-1a rolling n-gram hashing into a
+// fixed feature space, emitting numeric CSR triplets — no string
+// marshaling back to Python. Hash semantics are bit-identical to
+// keystone_tpu/ops/nlp/hashing_tf.py (stable_hash + rolling combine).
+//
+// Non-ASCII bytes (>= 0x80) are treated as word characters, which
+// matches Python \w for letters; callers with heavy non-ASCII
+// punctuation should use the Python path.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <unordered_map>
+#include <algorithm>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kFnvOffset = 0x811C9DC5u;
+constexpr uint32_t kFnvPrime = 0x01000193u;
+
+inline bool is_word_byte(unsigned char c) {
+  return (c >= '0' && c <= '9') || (c >= 'A' && c <= 'Z') ||
+         (c >= 'a' && c <= 'z') || c == '_' || c >= 0x80;
+}
+
+struct DocOut {
+  std::vector<int32_t> cols;
+  std::vector<float> vals;
+};
+
+void process_doc(const char* begin, const char* end, int min_order,
+                 int max_order, int64_t num_features, bool binarize,
+                 DocOut* out) {
+  // trim
+  while (begin < end && static_cast<unsigned char>(*begin) <= ' ') ++begin;
+  while (end > begin && static_cast<unsigned char>(end[-1]) <= ' ') --end;
+
+  // tokenize + per-token FNV-1a over lowercased bytes
+  std::vector<uint32_t> token_hashes;
+  const char* p = begin;
+  while (p < end) {
+    while (p < end && !is_word_byte(static_cast<unsigned char>(*p))) ++p;
+    if (p >= end) break;
+    uint32_t h = kFnvOffset;
+    while (p < end && is_word_byte(static_cast<unsigned char>(*p))) {
+      unsigned char c = static_cast<unsigned char>(*p);
+      if (c >= 'A' && c <= 'Z') c += 'a' - 'A';
+      h = (h ^ c) * kFnvPrime;
+      ++p;
+    }
+    token_hashes.push_back(h);
+  }
+
+  // rolling n-gram hash counting (hashing_tf.py NGramsHashingTF.apply)
+  std::unordered_map<int32_t, float> counts;
+  const int64_t n = static_cast<int64_t>(token_hashes.size());
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t h = kFnvOffset;
+    for (int order = 1; order <= max_order; ++order) {
+      if (i + order > n) break;
+      h = (h ^ token_hashes[i + order - 1]) * kFnvPrime;
+      if (order >= min_order) {
+        counts[static_cast<int32_t>(h % num_features)] += 1.0f;
+      }
+    }
+  }
+
+  out->cols.reserve(counts.size());
+  out->vals.reserve(counts.size());
+  for (const auto& kv : counts) out->cols.push_back(kv.first);
+  std::sort(out->cols.begin(), out->cols.end());
+  for (int32_t c : out->cols) {
+    out->vals.push_back(binarize ? 1.0f : counts[c]);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fused trim/lowercase/tokenize/ngram-hash TF over a document batch.
+// docs: concatenated UTF-8 bytes; offsets: n_docs+1 byte offsets.
+// Emits CSR: row_ptr (n_docs+1), then up to `cap` (col, val) pairs in
+// document order with per-document columns ascending. Returns total nnz,
+// or -1 if `cap` was too small (caller re-invokes with a larger buffer).
+int64_t text_ngram_hash_tf(const char* docs, const int64_t* offsets,
+                           int64_t n_docs, int min_order, int max_order,
+                           int64_t num_features, int binarize,
+                           int64_t* row_ptr, int32_t* out_cols,
+                           float* out_vals, int64_t cap, int num_threads) {
+  if (n_docs == 0) {
+    row_ptr[0] = 0;
+    return 0;
+  }
+  std::vector<DocOut> results(static_cast<size_t>(n_docs));
+  int nt = num_threads > 0 ? num_threads : 1;
+  if (nt > n_docs) nt = static_cast<int>(n_docs);
+  std::vector<std::thread> workers;
+  workers.reserve(nt);
+  for (int t = 0; t < nt; ++t) {
+    workers.emplace_back([&, t]() {
+      for (int64_t i = t; i < n_docs; i += nt) {
+        process_doc(docs + offsets[i], docs + offsets[i + 1], min_order,
+                    max_order, num_features, binarize != 0, &results[i]);
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+
+  int64_t nnz = 0;
+  row_ptr[0] = 0;
+  for (int64_t i = 0; i < n_docs; ++i) {
+    nnz += static_cast<int64_t>(results[i].cols.size());
+    row_ptr[i + 1] = nnz;
+  }
+  if (nnz > cap) return -1;
+  int64_t at = 0;
+  for (int64_t i = 0; i < n_docs; ++i) {
+    std::memcpy(out_cols + at, results[i].cols.data(),
+                results[i].cols.size() * sizeof(int32_t));
+    std::memcpy(out_vals + at, results[i].vals.data(),
+                results[i].vals.size() * sizeof(float));
+    at += static_cast<int64_t>(results[i].cols.size());
+  }
+  return nnz;
+}
+
+}  // extern "C"

@@ -1,0 +1,83 @@
+"""FusedTextHashTF: native C++ text featurization is hash-identical to
+the composed Python chain (Trim -> LowerCase -> Tokenizer ->
+NGramsHashingTF)."""
+
+import numpy as np
+import pytest
+from jax.experimental import sparse as jsparse
+
+from keystone_tpu import native
+from keystone_tpu.ops.nlp import FusedTextHashTF, NGramsHashingTF
+from keystone_tpu.ops.nlp.string_utils import LowerCase, Tokenizer, Trim
+from keystone_tpu.parallel.dataset import Dataset
+
+DOCS = [
+    "  The quick Brown-Fox; jumps!! over_the lazy dog 42  ",
+    "hello",
+    "",
+    "a b a b a  --  punct,punct;punct",
+    "Numbers 123 and under_scores mix_9 OK",
+]
+
+
+def _python_reference(doc, orders, nf):
+    toks = Tokenizer().apply(LowerCase().apply(Trim().apply(doc)))
+    return NGramsHashingTF(orders, nf).apply(toks)
+
+
+@pytest.mark.parametrize("orders", [[1], [1, 2], [2, 3]])
+def test_fused_matches_python_chain(orders):
+    nf = 4096
+    node = FusedTextHashTF(orders, nf)
+    for doc in DOCS:
+        got = node.apply(doc)
+        want = _python_reference(doc, orders, nf)
+        np.testing.assert_array_equal(
+            np.asarray(got.indices), np.asarray(want.indices)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.data), np.asarray(want.data)
+        )
+
+
+def test_fused_batch_and_binarize():
+    nf = 512
+    ds = Dataset.from_items(DOCS)
+    mat = FusedTextHashTF([1, 2], nf).apply_batch(ds).padded()
+    assert mat.shape == (len(DOCS), nf)
+    dense = np.asarray(mat.todense())
+    # row parity vs per-doc python reference
+    for r, doc in enumerate(DOCS):
+        want = np.zeros(nf, np.float32)
+        ref = _python_reference(doc, [1, 2], nf)
+        want[np.asarray(ref.indices).reshape(-1)] = np.asarray(ref.data)
+        np.testing.assert_array_equal(dense[r], want)
+    binar = FusedTextHashTF([1, 2], nf, binarize=True).apply_batch(ds)
+    db = np.asarray(binar.padded().todense())
+    np.testing.assert_array_equal(db, (dense > 0).astype(np.float32))
+
+
+def test_non_ascii_falls_back_to_python():
+    node = FusedTextHashTF([1], 256)
+    doc = "café résumé test"
+    got = node.apply(doc)  # must not crash; python path handles unicode
+    want = _python_reference(doc, [1], 256)
+    np.testing.assert_array_equal(
+        np.asarray(got.indices), np.asarray(want.indices)
+    )
+
+
+def test_native_path_is_active():
+    if native.text_ngram_hash_tf(["probe doc"], 1, 1, 64) is None:
+        pytest.skip("native library unavailable")
+    out = native.text_ngram_hash_tf(["a b c", "c d"], 1, 2, 1024)
+    row_ptr, cols, vals = out
+    assert row_ptr[-1] == len(cols) == len(vals)
+    assert row_ptr.tolist() == [0, 5, 8]  # 3+2 unigrams, 2+1 bigrams
+
+
+def test_zero_num_features_raises_not_sigfpe():
+    with pytest.raises(ValueError):
+        FusedTextHashTF([1], 0)
+    with pytest.raises(ValueError):
+        native.text_ngram_hash_tf(["a"], 1, 1, 0)
