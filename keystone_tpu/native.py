@@ -33,7 +33,7 @@ def _load() -> Optional[ctypes.CDLL]:
         os.path.getmtime(os.path.join(_NATIVE_DIR, f))
         > os.path.getmtime(_LIB_PATH)
         for f in os.listdir(_NATIVE_DIR)
-        if f.endswith(".cc")
+        if f.endswith(".cc") or f == "Makefile"
     )
     if (not os.path.exists(_LIB_PATH) or stale) and os.path.exists(
         os.path.join(_NATIVE_DIR, "Makefile")

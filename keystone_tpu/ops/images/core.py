@@ -65,6 +65,11 @@ class Convolver(Transformer):
     whitener: Optional[Any] = None
     normalize_patches: bool = True
     var_constant: float = 10.0
+    fast: bool = False  # True trades ~0.4% feature error for MXU-native
+    # speed: f32 inputs then run at TPU DEFAULT matmul precision (bf16
+    # passes) instead of HIGHEST. The default keeps f32 semantics — the
+    # patch-variance term s2 − P·m² cancels a decimal order on byte-range
+    # images, which DEFAULT precision cannot represent.
 
     def __post_init__(self):
         C = self.img_channels
@@ -107,25 +112,27 @@ class Convolver(Transformer):
         k = self.conv_size
         C = self.img_channels
         x = imgs.astype(jnp.float32)
+        hp = None if self.fast else jax.lax.Precision.HIGHEST
         # XLA correlation: out[n,x,y,f] = Σ A[n,x+dx,y+dy,c]·W[f,dx,dy,c]
         dn = jax.lax.conv_dimension_numbers(
             x.shape, self._W.shape, ("NHWC", "OHWI", "NHWC")
         )
         raw = jax.lax.conv_general_dilated(
             x, self._W, (1, 1), "VALID", dimension_numbers=dn,
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=hp,
         )
         if not self.normalize_patches and self._whitener_dot is None:
             return raw
         P = k * k * C
         ones = jnp.ones((1, k, k, C), jnp.float32)
         s1 = jax.lax.conv_general_dilated(
-            x, ones, (1, 1), "VALID", dimension_numbers=dn
+            x, ones, (1, 1), "VALID", dimension_numbers=dn, precision=hp
         )
         out = raw
         if self.normalize_patches:
             s2 = jax.lax.conv_general_dilated(
-                x * x, ones, (1, 1), "VALID", dimension_numbers=dn
+                x * x, ones, (1, 1), "VALID", dimension_numbers=dn,
+                precision=hp,
             )
             m = s1 / P
             # Stats.normalizeRows: var over patch entries, /(P-1), +alpha

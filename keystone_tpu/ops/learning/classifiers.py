@@ -92,12 +92,19 @@ def _logistic_vg(W, x, onehot, mask, n, reg):
     """Softmax cross-entropy mean loss + L2 and its gradient — the
     traceable ``vg(W, *data)`` the fused device L-BFGS consumes (module
     level so the compiled optimizer is cached across fits)."""
+    # HIGHEST for f32 (TPU DEFAULT truncates operands to bf16 —
+    # block_ls._f32_mm); bf16 data keeps the native MXU path
+    hp = (
+        jax.lax.Precision.HIGHEST
+        if not isinstance(x, jsparse.BCOO) and x.dtype == jnp.float32
+        else None
+    )
     if isinstance(x, jsparse.BCOO):
         logits = jsparse.bcoo_dot_general(
             x, W, dimension_numbers=(([1], [0]), ([], []))
         )
     else:
-        logits = x @ W
+        logits = jnp.matmul(x, W, precision=hp)
     logz = jax.scipy.special.logsumexp(logits, axis=1)
     ll = jnp.sum((logz - jnp.sum(logits * onehot, axis=1)) * mask)
     p = jnp.exp(logits - logz[:, None]) * mask[:, None]
@@ -106,7 +113,7 @@ def _logistic_vg(W, x, onehot, mask, n, reg):
             x, p - onehot, dimension_numbers=(([0], [0]), ([], []))
         )
     else:
-        g = x.T @ (p - onehot)
+        g = jnp.matmul(x.T, p - onehot, precision=hp)
     return ll / n + 0.5 * reg * jnp.sum(W * W), g / n + reg * W
 
 

@@ -67,17 +67,25 @@ class LeastSquaresDenseGradient(Gradient):
     (reference: Gradient.scala:29)."""
 
     def value_and_grad(self, A, b, W):
+        # HIGHEST for f32 inputs — TPU DEFAULT truncates f32 matmul
+        # operands to bf16 (see block_ls._f32_mm); bf16 data keeps the
+        # native MXU path
+        hp = (
+            jax.lax.Precision.HIGHEST
+            if A.dtype == jnp.float32
+            else None
+        )
         res = (
             jax.lax.dot_general(
                 A, W, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
+                preferred_element_type=jnp.float32, precision=hp,
             )
             - b
         )
         loss = 0.5 * jnp.sum(res * res)
         grad = jax.lax.dot_general(
             A.T, res, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=jnp.float32, precision=hp,
         )
         return loss, grad
 

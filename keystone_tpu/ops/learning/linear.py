@@ -28,7 +28,17 @@ from keystone_tpu.workflow.api import LabelEstimator, Transformer
 
 @jax.jit
 def _grams(A, b):
-    return A.T @ A, A.T @ b
+    # HIGHEST for f32 (TPU DEFAULT truncates operands to bf16 —
+    # block_ls._f32_mm); bf16 data keeps the native MXU path
+    hp = (
+        jax.lax.Precision.HIGHEST
+        if A.dtype == jnp.float32
+        else None
+    )
+    return (
+        jnp.matmul(A.T, A, precision=hp),
+        jnp.matmul(A.T, b, precision=hp),
+    )
 
 
 @dataclasses.dataclass(eq=False)

@@ -49,14 +49,19 @@ def _class_chunk_stats(X, R, idx, wt, counts, class_ids, start, *, width):
     Xg = Xb[idx] * wt[:, :, None]  # (G, m, b)
     inv = 1.0 / counts
     class_mean = jnp.einsum("gmb->gb", Xg) * inv[:, None]
+    # HIGHEST: the centered covariance cancels mean^2-scale terms; TPU
+    # DEFAULT precision would truncate f32 operands to bf16 passes
+    # (block_ls._f32_mm documents the measured failure)
+    hp = jax.lax.Precision.HIGHEST
     class_cov = (
-        jnp.einsum("gmb,gmc->gbc", Xg, Xg, preferred_element_type=jnp.float32)
+        jnp.einsum("gmb,gmc->gbc", Xg, Xg,
+                   preferred_element_type=jnp.float32, precision=hp)
         * inv[:, None, None]
         - class_mean[:, :, None] * class_mean[:, None, :]
     )
     # resLocal_c = R[rows of c, c]
     r_g = R[idx, class_ids[:, None]] * wt  # (G, m)
-    class_xtr = jnp.einsum("gmb,gm->gb", Xg, r_g) * inv[:, None]
+    class_xtr = jnp.einsum("gmb,gm->gb", Xg, r_g, precision=hp) * inv[:, None]
     res_local_mean = jnp.einsum("gm->g", r_g) * inv
     return class_cov, class_mean, class_xtr, res_local_mean
 

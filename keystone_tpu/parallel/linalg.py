@@ -29,9 +29,17 @@ from keystone_tpu.parallel import mesh as mesh_lib
 
 @jax.jit
 def gram(A):
-    """AᵀA with f32 accumulation."""
+    """AᵀA with f32 accumulation. f32 inputs force HIGHEST precision:
+    TPU's DEFAULT truncates f32 matmul operands to bf16 passes (see
+    ops/learning/block_ls._f32_mm for the measured failure)."""
+    hp = (
+        jax.lax.Precision.HIGHEST
+        if A.dtype == jnp.float32
+        else None
+    )
     return jax.lax.dot_general(
-        A.T, A, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        A.T, A, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=hp,
     )
 
 

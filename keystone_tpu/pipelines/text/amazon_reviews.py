@@ -91,10 +91,12 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--nGrams", type=int, default=2)
     p.add_argument("--commonFeatures", type=int, default=100_000)
     p.add_argument("--numIters", type=int, default=20)
+    p.add_argument("--hashing", action="store_true",
+                   help="fused native hashed n-gram features")
     a = p.parse_args(argv)
     conf = AmazonReviewsConfig(
         a.trainLocation, a.testLocation, a.threshold, a.nGrams,
-        a.commonFeatures, a.numIters,
+        a.commonFeatures, a.numIters, a.hashing,
     )
     train = AmazonReviewsDataLoader(conf.train_location, conf.threshold)
     test = AmazonReviewsDataLoader(conf.test_location, conf.threshold)

@@ -19,6 +19,7 @@ from keystone_tpu.loaders.text_loaders import (
 )
 from keystone_tpu.ops.learning.classifiers import NaiveBayesEstimator
 from keystone_tpu.ops.nlp import (
+    FusedTextHashTF,
     LowerCase,
     NGramsFeaturizer,
     Tokenizer,
@@ -35,10 +36,21 @@ class NewsgroupsConfig:
     test_location: str = ""
     n_grams: int = 2
     common_features: int = 100_000
+    hashing: bool = False  # hashed n-gram features via the fused native
+    # C++ featurizer instead of string-keyed top-K selection (reference
+    # alternative: nodes/nlp/HashingTF.scala)
 
 
 def build_pipeline(train: LabeledData, conf: NewsgroupsConfig) -> Pipeline:
     num_classes = len(NEWSGROUPS_CLASSES)
+    if conf.hashing:
+        featurizer = FusedTextHashTF(
+            range(1, conf.n_grams + 1), conf.common_features,
+            binarize=True,
+        ).to_pipeline()
+        return featurizer.and_then(
+            NaiveBayesEstimator(num_classes), train.data, train.labels
+        ).and_then(MaxClassifier())
     featurizer = (
         Trim()
         .and_then(LowerCase())
@@ -66,9 +78,12 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--testLocation", required=True)
     p.add_argument("--nGrams", type=int, default=2)
     p.add_argument("--commonFeatures", type=int, default=100_000)
+    p.add_argument("--hashing", action="store_true",
+                   help="fused native hashed n-gram features")
     a = p.parse_args(argv)
     conf = NewsgroupsConfig(
-        a.trainLocation, a.testLocation, a.nGrams, a.commonFeatures
+        a.trainLocation, a.testLocation, a.nGrams, a.commonFeatures,
+        a.hashing,
     )
     train = NewsgroupsDataLoader(conf.train_location)
     test = NewsgroupsDataLoader(conf.test_location)

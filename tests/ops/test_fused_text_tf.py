@@ -65,6 +65,9 @@ def test_non_ascii_falls_back_to_python():
     np.testing.assert_array_equal(
         np.asarray(got.indices), np.asarray(want.indices)
     )
+    np.testing.assert_array_equal(
+        np.asarray(got.data), np.asarray(want.data)
+    )
 
 
 def test_native_path_is_active():

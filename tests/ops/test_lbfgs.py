@@ -96,3 +96,42 @@ def test_device_lbfgs_logistic_regression_learns():
     ).fit(Xd, yd)
     hp = np.asarray(host.apply_batch(Xd).padded())
     assert (preds == hp).mean() > 0.95
+
+
+def test_device_lbfgs_line_search_failure_terminates():
+    """A pathological objective whose 'gradient' points uphill everywhere
+    makes every Armijo trial fail; the driver must stop cleanly at w0
+    rather than loop or return NaN."""
+    from keystone_tpu.ops.learning.lbfgs import run_lbfgs_device
+
+    def bad_vg(w):
+        # claims descent direction -g, but f grows along it
+        return jnp.sum(w * w) + 1.0, -jnp.ones_like(w)
+
+    w = run_lbfgs_device(bad_vg, jnp.zeros((4, 2)), 10)
+    assert np.isfinite(np.asarray(w)).all()
+    # at f32 resolution the backtracked step may be accepted at rounding
+    # noise; the property that matters is no runaway along the bogus
+    # direction
+    assert np.abs(np.asarray(w)).max() < 1e-3
+
+
+def test_device_lbfgs_convergence_tol_is_traced():
+    """Different tolerances reuse one compiled program (tol is a traced
+    argument, not a static one)."""
+    from keystone_tpu.ops.learning.lbfgs import (
+        _lbfgs_device_run, run_lbfgs_device,
+    )
+
+    def quad_vg(w, A):
+        return 0.5 * jnp.sum((A @ w) * w), A @ w
+
+    A = jnp.eye(8) * jnp.arange(1.0, 9.0)
+    w0 = jnp.ones((8,))
+    before = _lbfgs_device_run._cache_size()
+    w1 = run_lbfgs_device(quad_vg, w0, 50, convergence_tol=1e-2, data=(A,))
+    w2 = run_lbfgs_device(quad_vg, w0, 50, convergence_tol=1e-8, data=(A,))
+    after = _lbfgs_device_run._cache_size()
+    assert after - before == 1  # one compile for both tolerances
+    # tighter tolerance gets at least as close to the optimum (0)
+    assert np.abs(np.asarray(w2)).max() <= np.abs(np.asarray(w1)).max() + 1e-6

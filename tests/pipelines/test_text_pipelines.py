@@ -97,3 +97,32 @@ def test_timit_pipeline_tiny(mesh8):
     )
     _, metrics = timit_run(train, train, conf)
     assert metrics.total_accuracy > 0.9
+
+
+def test_newsgroups_hashing_mode(mesh8):
+    rng = np.random.default_rng(5)
+    vocabs = [["compiler", "kernel", "gpu"], ["baseball", "pitcher", "inning"]]
+    texts, labels = [], []
+    for _ in range(60):
+        c = int(rng.random() < 0.5)
+        texts.append(" ".join(rng.choice(vocabs[c], 6)))
+        labels.append(c)
+    import jax.numpy as jnp
+
+    data = LabeledData(
+        labels=Dataset.from_array(jnp.asarray(labels, jnp.int32)),
+        data=Dataset.from_items(texts),
+    )
+    conf = NewsgroupsConfig(common_features=1024, hashing=True)
+    _, metrics = news_run(data, data, conf)
+    assert metrics.total_accuracy > 0.9
+
+
+def test_amazon_hashing_mode(mesh8):
+    train = _sentiment_data(80, seed=0)
+    test = _sentiment_data(20, seed=1)
+    conf = AmazonReviewsConfig(
+        common_features=1024, num_iters=30, hashing=True
+    )
+    _, metrics = amazon_run(train, test, conf)
+    assert metrics.accuracy > 0.9
