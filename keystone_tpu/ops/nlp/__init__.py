@@ -9,6 +9,16 @@ from keystone_tpu.ops.nlp.hashing_tf import (
     HashingTF,
     NGramsHashingTF,
 )
+from keystone_tpu.ops.nlp.external import (
+    NER,
+    CoreNLPFeatureExtractor,
+    POSTagger,
+)
+from keystone_tpu.ops.nlp.tagging import (
+    PerceptronTaggerEstimator,
+    rule_ner_tag,
+    rule_pos_tag,
+)
 from keystone_tpu.ops.nlp.word_frequency import (
     WordFrequencyEncoder,
     WordFrequencyTransformer,
@@ -29,7 +39,11 @@ __all__ = [
     "NGramIndexer",
     "NGramsCounts",
     "NGramsFeaturizer",
+    "NER",
     "NGramsHashingTF",
+    "POSTagger",
+    "PerceptronTaggerEstimator",
+    "CoreNLPFeatureExtractor",
     "NaiveBitPackIndexer",
     "StupidBackoffEstimator",
     "StupidBackoffModel",
@@ -38,4 +52,6 @@ __all__ = [
     "WordFrequencyEncoder",
     "WordFrequencyTransformer",
     "initial_bigram_partition",
+    "rule_ner_tag",
+    "rule_pos_tag",
 ]

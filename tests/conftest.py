@@ -13,9 +13,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from keystone_tpu.parallel.virtual import provision_devices  # noqa: E402
 
-# Tests always run on the virtual CPU mesh (fast, deterministic, no TPU
-# needed) — skip the real-device probe.
-provision_devices(8, probe_real=False)
+# Tests run on the virtual CPU mesh by default (fast, deterministic, no
+# TPU needed). KEYSTONE_TPU_TEST_REAL=1 runs the same suite against the
+# real accelerator instead — the hardware-sanity sweep that catches
+# TPU-only failures (e.g. DEFAULT-precision f32 matmuls) CPU runs hide.
+_REAL = os.environ.get("KEYSTONE_TPU_TEST_REAL") == "1"
+if not _REAL:
+    provision_devices(8, probe_real=False)
+else:
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        raise RuntimeError(
+            "KEYSTONE_TPU_TEST_REAL=1 but no accelerator is attached — "
+            "this sweep exists to catch hardware-only failures; running "
+            "it on CPU would silently prove nothing"
+        )
 
 import pytest  # noqa: E402
 
@@ -36,9 +49,13 @@ def reset_pipeline_env():
 
 @pytest.fixture
 def mesh8():
-    """An 8-way data-parallel mesh over the virtual CPU devices."""
+    """An 8-way data-parallel mesh over the virtual CPU devices (or
+    whatever the real hardware has under KEYSTONE_TPU_TEST_REAL=1)."""
+    import jax
+
     from keystone_tpu.parallel import mesh as mesh_lib
 
-    m = mesh_lib.make_mesh(n_data=8)
+    n = min(8, len(jax.devices())) if _REAL else 8
+    m = mesh_lib.make_mesh(n_data=n, devices=jax.devices()[:n])
     with mesh_lib.use_mesh(m):
         yield m

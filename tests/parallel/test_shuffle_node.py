@@ -1,6 +1,15 @@
 """Shuffler node device path agrees bit-for-bit with the host path."""
 
 import jax
+import pytest as _pytest
+
+if len(jax.devices()) < 8:  # real-hardware sweep on fewer chips
+    pytestmark = _pytest.mark.skip(
+        reason="needs the 8-device (virtual) mesh"
+    )
+
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
