@@ -24,6 +24,7 @@ from keystone_tpu.ops.learning.gmm import (
     GaussianMixtureModelEstimator,
 )
 from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.utils.precision import mm
 from keystone_tpu.workflow.api import Estimator, Transformer
 from keystone_tpu.workflow.node_optimization import Optimizable
 
@@ -36,8 +37,8 @@ def _fisher_vector(fv_self, x):
     m = x.shape[1]
     q = gmm._posteriors(x.T)  # (m, k)
     s0 = jnp.mean(q, axis=0)  # (k,)
-    s1 = (x @ q) / m  # (d, k)
-    s2 = ((x * x) @ q) / m  # (d, k)
+    s1 = mm(x, q) / m  # (d, k)
+    s2 = mm(x * x, q) / m  # (d, k)
     means, variances = gmm.means, gmm.variances  # (d, k)
     weights = gmm.weights  # (k,)
     fv1 = (s1 - means * s0[None, :]) / (

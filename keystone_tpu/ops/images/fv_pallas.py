@@ -46,8 +46,10 @@ def _fv_stats_kernel(
     x2 = x * x
     logits = (
         -0.5 * jnp.dot(x2, inv_var_ref[:],
+                       precision=jax.lax.Precision.HIGHEST,
                        preferred_element_type=jnp.float32)
-        + jnp.dot(x, proj_ref[:], preferred_element_type=jnp.float32)
+        + jnp.dot(x, proj_ref[:], preferred_element_type=jnp.float32,
+                  precision=jax.lax.Precision.HIGHEST)
         + const_ref[:]
     )  # (TILE_M, k)
     logits = logits - jnp.max(logits, axis=1, keepdims=True)
@@ -65,8 +67,10 @@ def _fv_stats_kernel(
     q = jnp.where(rows < m_valid_ref[0], q, 0.0)
 
     s0_ref[:] += jnp.sum(q, axis=0, keepdims=True)
-    s1_ref[:] += jnp.dot(x.T, q, preferred_element_type=jnp.float32)
-    s2_ref[:] += jnp.dot(x2.T, q, preferred_element_type=jnp.float32)
+    s1_ref[:] += jnp.dot(x.T, q, preferred_element_type=jnp.float32,
+                         precision=jax.lax.Precision.HIGHEST)
+    s2_ref[:] += jnp.dot(x2.T, q, preferred_element_type=jnp.float32,
+                         precision=jax.lax.Precision.HIGHEST)
 
 
 @partial(jax.jit, static_argnames=("interpret",))

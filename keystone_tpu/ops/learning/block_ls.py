@@ -107,12 +107,12 @@ def _block_step(X, R, Wb, mu_b, mask, start, lam, *, width: int, n: int):
     round trip (BlockLinearMapper.scala:234-240) becomes one dispatch.
     """
     Xb = jax.lax.dynamic_slice_in_dim(X, start, width, axis=1)
-    contrib = _f32_mm(Xb, Wb) - mask[:, None] * (mu_b @ Wb)
+    contrib = _f32_mm(Xb, Wb) - mask[:, None] * _f32_mm(mu_b, Wb)
     R_plus = R + contrib
     gram = _f32_mm(Xb.T, Xb) - n * jnp.outer(mu_b, mu_b)
     rhs = _f32_mm(Xb.T, R_plus) - jnp.outer(mu_b, jnp.sum(R_plus, axis=0))
     Wb_new = _psd_solve_device(gram, rhs, lam)
-    contrib_new = _f32_mm(Xb, Wb_new) - mask[:, None] * (mu_b @ Wb_new)
+    contrib_new = _f32_mm(Xb, Wb_new) - mask[:, None] * _f32_mm(mu_b, Wb_new)
     return Wb_new, R_plus - contrib_new
 
 
@@ -129,7 +129,7 @@ def _block_stats(X, R, Wb, mu_b, mask, start, *, width: int, n: int):
     ``start`` is traced so every equal-width block shares this compilation.
     """
     Xb = jax.lax.dynamic_slice_in_dim(X, start, width, axis=1)
-    contrib = _f32_mm(Xb, Wb) - mask[:, None] * (mu_b @ Wb)
+    contrib = _f32_mm(Xb, Wb) - mask[:, None] * _f32_mm(mu_b, Wb)
     R_plus = R + contrib
     gram = _f32_mm(Xb.T, Xb) - n * jnp.outer(mu_b, mu_b)
     rhs = _f32_mm(Xb.T, R_plus) - jnp.outer(mu_b, jnp.sum(R_plus, axis=0))
@@ -139,7 +139,7 @@ def _block_stats(X, R, Wb, mu_b, mask, start, *, width: int, n: int):
 @partial(jax.jit, static_argnames=("width",), donate_argnums=(1,))
 def _residual_update(X, R_plus, Wb_new, mu_b, mask, start, *, width: int):
     Xb = jax.lax.dynamic_slice_in_dim(X, start, width, axis=1)
-    contrib = _f32_mm(Xb, Wb_new) - mask[:, None] * (mu_b @ Wb_new)
+    contrib = _f32_mm(Xb, Wb_new) - mask[:, None] * _f32_mm(mu_b, Wb_new)
     return R_plus - contrib
 
 
@@ -177,16 +177,17 @@ class BlockLinearMapper(Transformer):
             return self.explicit_intercept
         if self.label_mean is None:
             return None
-        fm = 0.0 if self.feature_mean is None else self.feature_mean
-        return self.label_mean - fm @ self.W
+        if self.feature_mean is None:
+            return self.label_mean
+        return self.label_mean - _f32_mm(self.feature_mean, self.W)
 
     def apply(self, x):
-        out = x @ self.W
+        out = _f32_mm(x, self.W)
         icpt = self.intercept
         return out if icpt is None else out + icpt
 
     def apply_batch(self, ds: Dataset) -> Dataset:
-        out = ds.padded() @ self.W
+        out = _f32_mm(ds.padded(), self.W)
         icpt = self.intercept
         if icpt is not None:
             out = (out + icpt) * ds.mask()[:, None]
@@ -204,7 +205,7 @@ class BlockLinearMapper(Transformer):
         acc = jnp.zeros((X.shape[0], self.W.shape[1]), X.dtype)
         for start in range(0, D, self.block_size):
             end = min(start + self.block_size, D)
-            acc = acc + X[:, start:end] @ self.W[start:end]
+            acc = acc + _f32_mm(X[:, start:end], self.W[start:end])
             out = acc if icpt is None else (acc + icpt) * ds.mask()[:, None]
             evaluator(out)
 

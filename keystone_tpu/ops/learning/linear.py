@@ -22,6 +22,7 @@ import numpy as np
 from jax.experimental import sparse as jsparse
 
 from keystone_tpu.ops.learning.hostsolve import psd_solve_host
+from keystone_tpu.utils.precision import mm
 from keystone_tpu.parallel.dataset import Dataset
 from keystone_tpu.workflow.api import LabelEstimator, Transformer
 
@@ -53,7 +54,7 @@ class LinearMapper(Transformer):
     def apply(self, x):
         if self.feature_scaler is not None:
             x = self.feature_scaler.apply(x)
-        out = x @ self.W
+        out = mm(x, self.W)
         if self.intercept is not None:
             out = out + self.intercept
         return out
@@ -61,7 +62,7 @@ class LinearMapper(Transformer):
     def apply_batch(self, ds: Dataset) -> Dataset:
         if self.feature_scaler is not None:
             ds = self.feature_scaler.apply_batch(ds)
-        out = ds.padded() @ self.W
+        out = mm(ds.padded(), self.W)
         if self.intercept is not None:
             out = (out + self.intercept) * ds.mask()[:, None]
         return Dataset.from_array(out, n=ds.n)
@@ -103,7 +104,7 @@ class LinearMapEstimator(LabelEstimator):
         """0.5·‖AW − b‖² + 0.5·λ‖W‖² (reference: LinearMapper.computeCost)."""
         A = data.padded()
         b = labels.padded()
-        pred = A @ W
+        pred = mm(A, W)
         if intercept is not None:
             pred = (pred + intercept) * data.mask()[:, None]
         res = jnp.sum((pred - b) ** 2)
@@ -122,7 +123,7 @@ class LocalLeastSquaresEstimator(LabelEstimator):
         A = data.array()
         b = labels.array()
         n = A.shape[0]
-        K = jax.jit(lambda A: A @ A.T)(A)
+        K = jax.jit(lambda A: mm(A, A.T))(A)
         alpha = psd_solve_host(K, np.asarray(b), self.lam * n)
         W = jnp.asarray(np.asarray(A).T @ alpha, A.dtype)
         return LinearMapper(W)

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.utils.precision import mm
 from keystone_tpu.workflow.api import Estimator, Transformer
 
 
@@ -24,10 +25,10 @@ class ZCAWhitener(Transformer):
 
     def apply(self, x):
         # works for a (d,) vector or an (m, d) row-major patch matrix
-        return (x - self.means) @ self.whitener
+        return mm(x - self.means, self.whitener)
 
     def apply_batch(self, ds: Dataset) -> Dataset:
-        out = (ds.padded() - self.means) @ self.whitener
+        out = mm(ds.padded() - self.means, self.whitener)
         out = out * ds.mask()[:, None] if out.ndim == 2 else out
         return Dataset.from_array(out, n=ds.n)
 
@@ -51,5 +52,5 @@ class ZCAWhitenerEstimator(Estimator):
         centered = x - means
         _, s, vt = jnp.linalg.svd(centered, full_matrices=False)
         scale = 1.0 / jnp.sqrt(s * s / (n - 1.0) + self.eps)
-        whitener = (vt.T * scale[None, :]) @ vt
+        whitener = mm(vt.T * scale[None, :], vt)
         return ZCAWhitener(whitener, means)

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.utils.precision import mm
 from keystone_tpu.workflow.api import Estimator, FunctionNode, Transformer
 
 
@@ -267,11 +268,11 @@ class CosineRandomFeatures(Transformer):
         )
 
     def apply(self, x):
-        return jnp.cos(x @ self.W.T + self.b)
+        return jnp.cos(mm(x, self.W.T) + self.b)
 
     def apply_batch(self, ds: Dataset) -> Dataset:
         x = ds.padded()
-        out = jnp.cos(x @ self.W.T + self.b)
+        out = jnp.cos(mm(x, self.W.T) + self.b)
         # cos(0 + b) != 0: keep the pad-rows-are-zero invariant
         out = out * ds.mask()[:, None]
         return Dataset.from_array(out, n=ds.n)

@@ -1,0 +1,29 @@
+"""Shared f32 matmul precision policy (see README).
+
+TPU's DEFAULT matmul precision truncates f32 operands to bf16 passes
+(~1e-3 relative error). Solver math and model application request
+HIGHEST for f32 inputs; bf16 inputs keep the native one-pass MXU path —
+users choose speed by passing bf16 data, not by losing f32 semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def hi_if_f32(*arrays):
+    """``precision=`` value: HIGHEST when any operand is f32."""
+    return (
+        jax.lax.Precision.HIGHEST
+        if any(a.dtype == jnp.float32 for a in arrays)
+        else None
+    )
+
+
+def mm(a, b):
+    """a @ b with f32 accumulation under the precision policy."""
+    return jnp.matmul(
+        a, b, precision=hi_if_f32(a, b),
+        preferred_element_type=jnp.float32,
+    )

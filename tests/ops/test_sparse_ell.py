@@ -10,7 +10,6 @@ if len(jax.devices()) < 8:  # real-hardware sweep on fewer chips
     )
 
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
