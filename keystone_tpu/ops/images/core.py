@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.utils.precision import mm
 from keystone_tpu.workflow.api import FunctionNode, Transformer
 
 # MATLAB rgb2gray weights (reference: utils/images/ImageUtils.scala:73-76)
@@ -84,9 +85,9 @@ class Convolver(Transformer):
         self._filter_sums = jnp.sum(self._W, axis=(1, 2, 3))  # S_f
         if self.whitener is not None:
             flat = self._W.transpose(0, 2, 1, 3).reshape(F, -1)
-            self._whitener_dot = flat @ jnp.asarray(
+            self._whitener_dot = mm(flat, jnp.asarray(
                 self.whitener.means, jnp.float32
-            )
+            ))
         else:
             self._whitener_dot = None
 

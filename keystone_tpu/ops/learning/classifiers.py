@@ -22,6 +22,7 @@ from jax.experimental import sparse as jsparse
 
 from keystone_tpu.ops.learning.lbfgs import run_lbfgs, run_lbfgs_device
 from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.utils.precision import mm
 from keystone_tpu.workflow.api import LabelEstimator, Transformer
 
 
@@ -34,7 +35,9 @@ class NaiveBayesModel(Transformer):
     theta: Any  # (k, d) log feature likelihoods
 
     def apply(self, x):
-        return self.pi + x @ self.theta.T
+        if isinstance(x, jsparse.BCOO):
+            return self.pi + x @ self.theta.T
+        return self.pi + mm(x, self.theta.T)
 
     def apply_batch(self, ds: Dataset) -> Dataset:
         x = ds.padded()
@@ -43,7 +46,7 @@ class NaiveBayesModel(Transformer):
                 x, self.theta.T, dimension_numbers=(([1], [0]), ([], []))
             )
         else:
-            scores = self.pi + x @ self.theta.T
+            scores = self.pi + mm(x, self.theta.T)
         return Dataset.from_array(scores * ds.mask()[:, None], n=ds.n)
 
 
@@ -68,7 +71,7 @@ class NaiveBayesEstimator(LabelEstimator):
                 dimension_numbers=(([0], [0]), ([], [])),
             ).T
         else:
-            counts = _pad_rows(onehot, x.shape[0]).T @ x
+            counts = mm(_pad_rows(onehot, x.shape[0]).T, x)
         class_counts = np.bincount(y, minlength=self.num_classes)
         pi = jnp.log(
             (jnp.asarray(class_counts, jnp.float32) + self.lam)
@@ -128,7 +131,9 @@ class LogisticRegressionModel(Transformer):
     W: Any  # (d, k)
 
     def apply(self, x):
-        return jnp.argmax(x @ self.W, axis=-1)
+        if isinstance(x, jsparse.BCOO):
+            return jnp.argmax(x @ self.W, axis=-1)
+        return jnp.argmax(mm(x, self.W), axis=-1)
 
     def apply_batch(self, ds: Dataset) -> Dataset:
         x = ds.padded()
@@ -137,7 +142,7 @@ class LogisticRegressionModel(Transformer):
                 x, self.W, dimension_numbers=(([1], [0]), ([], []))
             )
         else:
-            scores = x @ self.W
+            scores = mm(x, self.W)
         return Dataset.from_array(jnp.argmax(scores, axis=-1), n=ds.n)
 
 

@@ -32,6 +32,7 @@ import numpy as np
 
 from keystone_tpu.ops.learning.kmeans import KMeansPlusPlusEstimator
 from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.utils.precision import mm
 from keystone_tpu.workflow.api import Estimator, Transformer
 from keystone_tpu.workflow.node_optimization import Optimizable
 
@@ -153,8 +154,8 @@ class GaussianMixtureModelEstimator(Estimator):
             mass = jnp.sum(assign, axis=0)
             inv = 1.0 / jnp.maximum(mass, 1.0)
             weights = mass / n
-            mu = inv[:, None] * (assign.T @ X)
-            var = inv[:, None] * (assign.T @ xsq) - mu * mu
+            mu = inv[:, None] * mm(assign.T, X)
+            var = inv[:, None] * mm(assign.T, xsq) - mu * mu
         else:  # RANDOM_INITIALIZATION
             rng = np.random.default_rng(self.seed)
             col_min = jnp.min(X, axis=0)
@@ -206,8 +207,8 @@ class GaussianMixtureModelEstimator(Estimator):
                 break  # "Unbalanced clustering, try less centers"
             weights = q_sum / n
             inv = 1.0 / q_sum
-            mu = inv[:, None] * (q.T @ X)
-            var = inv[:, None] * (q.T @ xsq) - mu * mu
+            mu = inv[:, None] * mm(q.T, X)
+            var = inv[:, None] * mm(q.T, xsq) - mu * mu
             var = jnp.maximum(var, var_lb[None, :])
 
         return GaussianMixtureModel(

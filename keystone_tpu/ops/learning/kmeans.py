@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.utils.precision import mm
 from keystone_tpu.workflow.api import Estimator, Transformer
 
 
@@ -26,7 +27,7 @@ def _sq_dist_to_centers(X, means):
     XSqNormHlf − X μᵀ + MSqNormHlf."""
     xsq = 0.5 * jnp.sum(X * X, axis=1, keepdims=True)
     msq = 0.5 * jnp.sum(means * means, axis=1)
-    return xsq - X @ means.T + msq[None, :]
+    return xsq - mm(X, means.T) + msq[None, :]
 
 
 @jax.jit
@@ -76,6 +77,8 @@ class KMeansPlusPlusEstimator(Estimator):
         cur_sq_dist = None
         for k in range(self.num_means - 1):
             c = X[centers[k]]
+            # host f64 numpy on purpose: seeding is sequential and its
+            # distances feed a probability draw — keep full precision
             d_new = xsq_half - X @ c + 0.5 * (c @ c)
             cur_sq_dist = (
                 d_new if cur_sq_dist is None else np.minimum(d_new, cur_sq_dist)
@@ -98,7 +101,7 @@ class KMeansPlusPlusEstimator(Estimator):
                 jnp.argmin(d, axis=1), self.num_means, dtype=jnp.float32
             )
             mass = jnp.sum(assign, axis=0)
-            means = (assign.T @ Xd) / jnp.maximum(mass, 1.0)[:, None]
+            means = mm(assign.T, Xd) / jnp.maximum(mass, 1.0)[:, None]
             if prev_cost is not None and (
                 prev_cost - cost
             ) < self.stop_tolerance * abs(prev_cost):

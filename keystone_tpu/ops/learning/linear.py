@@ -143,7 +143,7 @@ class SparseLinearMapper(Transformer):
         if isinstance(x, jsparse.BCOO):
             out = x @ self.W
         else:
-            out = jnp.asarray(x) @ self.W
+            out = mm(jnp.asarray(x), self.W)
         if self.intercept is not None:
             out = out + self.intercept
         return out
@@ -155,7 +155,7 @@ class SparseLinearMapper(Transformer):
                 x, self.W, dimension_numbers=(([1], [0]), ([], []))
             )
         else:
-            out = x @ self.W
+            out = mm(x, self.W)
         if self.intercept is not None:
             out = out + self.intercept
         return Dataset.from_array(out, n=ds.n)

@@ -20,6 +20,7 @@ import numpy as np
 from keystone_tpu.ops.learning.cost import CostModel
 from keystone_tpu.parallel import linalg as plinalg
 from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.utils.precision import mm
 from keystone_tpu.workflow.api import Estimator, Transformer
 from keystone_tpu.workflow.node_optimization import Optimizable
 
@@ -40,10 +41,10 @@ class PCATransformer(Transformer):
     pca_mat: Any  # (d, dims)
 
     def apply(self, x):
-        return x @ self.pca_mat
+        return mm(x, self.pca_mat)
 
     def apply_batch(self, ds: Dataset) -> Dataset:
-        return Dataset.from_array(ds.padded() @ self.pca_mat, n=ds.n)
+        return Dataset.from_array(mm(ds.padded(), self.pca_mat), n=ds.n)
 
 
 @dataclasses.dataclass(eq=False)
@@ -55,7 +56,7 @@ class BatchPCATransformer(Transformer):
     vmap_batch = True
 
     def apply(self, m):
-        return self.pca_mat.T @ m
+        return mm(self.pca_mat.T, m)
 
     def apply_batch(self, ds: Dataset) -> Dataset:
         if ds.is_array:
@@ -155,12 +156,13 @@ class ApproximatePCAEstimator(Estimator, CostModel):
         l = min(self.dims + self.p, d)
         key = jax.random.PRNGKey(self.seed)
         omega = jax.random.normal(key, (d, l), jnp.float32)
-        Y = A @ omega
+        Y = mm(A, omega)  # (and B below): policy precision — B feeds the
+        # SVD directly, so truncation there lands in the PCA directions
         Q, _ = jnp.linalg.qr(Y)
         for _ in range(self.q):  # power iterations for spectral decay
-            Z, _ = jnp.linalg.qr(A.T @ Q)
-            Q, _ = jnp.linalg.qr(A @ Z)
-        B = Q.T @ A  # (l, d)
+            Z, _ = jnp.linalg.qr(mm(A.T, Q))
+            Q, _ = jnp.linalg.qr(mm(A, Z))
+        B = mm(Q.T, A)  # (l, d)
         _, _, vt = jnp.linalg.svd(B, full_matrices=False)
         pca = enforce_matlab_pca_sign_convention(vt.T)
         return PCATransformer(pca[:, : self.dims])

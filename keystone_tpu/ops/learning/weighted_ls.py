@@ -32,6 +32,7 @@ import numpy as np
 
 from keystone_tpu.ops.learning.block_ls import BlockLinearMapper, _f32_mm
 from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.utils.precision import mm
 from keystone_tpu.workflow.api import LabelEstimator
 
 
@@ -234,10 +235,10 @@ def _rwls_block_step(X, mu_b, B, y_zm, res, Wb, aTa, lam_eye, start,
     BX = Xzm * B[:, None]
     if first_pass:
         aTa = _f32_mm(Xzm.T, BX)
-    res_upd = res - BX @ Wb
+    res_upd = res - _f32_mm(BX, Wb)
     aTb = _f32_mm(Xzm.T, (y_zm * B)[:, None] - res_upd)
     Wb_new = jax.scipy.linalg.solve(aTa + lam_eye, aTb, assume_a="pos")
-    res_new = res_upd + BX @ Wb_new
+    res_new = res_upd + _f32_mm(BX, Wb_new)
     return Wb_new, res_new, aTa
 
 

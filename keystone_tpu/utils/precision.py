@@ -22,8 +22,16 @@ def hi_if_f32(*arrays):
 
 
 def mm(a, b):
-    """a @ b with f32 accumulation under the precision policy."""
+    """a @ b under the precision policy, preserving input dtype
+    semantics: f32 operands get HIGHEST precision with f32 output; bf16
+    operands keep the native MXU path AND a bf16 result, so a bf16
+    pipeline's activations stay bf16 through chained model applies.
+    (Solver internals that need f32 accumulation from bf16 inputs use
+    ``ops.learning.block_ls._f32_mm`` instead — the two helpers differ
+    only in that output contract.)"""
+    hp = hi_if_f32(a, b)
+    if hp is None:
+        return jnp.matmul(a, b)
     return jnp.matmul(
-        a, b, precision=hi_if_f32(a, b),
-        preferred_element_type=jnp.float32,
+        a, b, precision=hp, preferred_element_type=jnp.float32
     )
