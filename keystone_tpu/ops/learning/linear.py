@@ -123,7 +123,10 @@ class LocalLeastSquaresEstimator(LabelEstimator):
         A = data.array()
         b = labels.array()
         n = A.shape[0]
-        K = jax.jit(lambda A: mm(A, A.T))(A)
+        from keystone_tpu.ops.learning.block_ls import _f32_mm
+
+        # solver internal: f32 accumulation even for bf16 data
+        K = jax.jit(lambda A: _f32_mm(A, A.T))(A)
         alpha = psd_solve_host(K, np.asarray(b), self.lam * n)
         W = jnp.asarray(np.asarray(A).T @ alpha, A.dtype)
         return LinearMapper(W)

@@ -32,7 +32,6 @@ import numpy as np
 
 from keystone_tpu.ops.learning.block_ls import BlockLinearMapper, _f32_mm
 from keystone_tpu.parallel.dataset import Dataset
-from keystone_tpu.utils.precision import mm
 from keystone_tpu.workflow.api import LabelEstimator
 
 

@@ -23,9 +23,10 @@ def hi_if_f32(*arrays):
 
 def mm(a, b):
     """a @ b under the precision policy, preserving input dtype
-    semantics: f32 operands get HIGHEST precision with f32 output; bf16
-    operands keep the native MXU path AND a bf16 result, so a bf16
-    pipeline's activations stay bf16 through chained model applies.
+    semantics: any f32 operand triggers HIGHEST precision with f32
+    output; when BOTH operands are bf16 (data AND model params), the
+    native MXU path runs and the result stays bf16 — so keeping a whole
+    pipeline in bf16 requires bf16 weights too, not just bf16 data.
     (Solver internals that need f32 accumulation from bf16 inputs use
     ``ops.learning.block_ls._f32_mm`` instead — the two helpers differ
     only in that output contract.)"""

@@ -1,0 +1,48 @@
+"""f32 matmul precision policy (utils/precision.py, README section):
+f32 gets HIGHEST + f32 accumulation, bf16 keeps the native path AND its
+dtype through model applies."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.ops.images import Convolver
+from keystone_tpu.ops.learning.linear import LinearMapper
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.utils.precision import hi_if_f32, mm
+
+import jax
+
+
+def test_hi_if_f32_gating():
+    f32 = jnp.ones((2, 2), jnp.float32)
+    b16 = jnp.ones((2, 2), jnp.bfloat16)
+    assert hi_if_f32(f32, f32) == jax.lax.Precision.HIGHEST
+    assert hi_if_f32(b16, f32) == jax.lax.Precision.HIGHEST
+    assert hi_if_f32(b16, b16) is None
+
+
+def test_mm_preserves_bf16_activations():
+    a = jnp.ones((4, 8), jnp.bfloat16)
+    w = jnp.ones((8, 3), jnp.bfloat16)
+    assert mm(a, w).dtype == jnp.bfloat16  # bf16 pipeline stays bf16
+    assert mm(a.astype(jnp.float32), w).dtype == jnp.float32
+
+
+def test_linear_mapper_bf16_pipeline_stays_bf16():
+    rng = np.random.default_rng(0)
+    W = jnp.asarray(rng.standard_normal((8, 3)), jnp.bfloat16)
+    x = jnp.asarray(rng.standard_normal((5, 8)), jnp.bfloat16)
+    out = LinearMapper(W).apply_batch(Dataset.from_array(x))
+    assert out.padded().dtype == jnp.bfloat16
+
+
+def test_convolver_fast_flag_close_to_exact():
+    rng = np.random.default_rng(1)
+    img = jnp.asarray((rng.random((12, 12, 3)) * 255).astype(np.float32))
+    filters = jnp.asarray(rng.standard_normal((8, 27)).astype(np.float32))
+    exact = Convolver(filters, 12, 12, 3, normalize_patches=True)
+    fast = Convolver(filters, 12, 12, 3, normalize_patches=True, fast=True)
+    a = np.asarray(exact.apply(img))
+    b = np.asarray(fast.apply(img))
+    # fast trades bounded error for speed; on CPU both paths are exact
+    assert np.abs(a - b).max() / np.abs(a).max() < 5e-3
