@@ -38,6 +38,7 @@ from keystone_tpu.workflow.api import LabelEstimator, Transformer
 from keystone_tpu.ops.learning.hostsolve import psd_solve_host
 from keystone_tpu.utils.checkpoint import (
     LoopCheckpointer,
+    data_probe,
     two_level_schedule,
 )
 
@@ -272,8 +273,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             fp = (
                 f"bls bs={self.block_size} it={self.num_iter} "
                 f"lam={self.lam} solve={self.solve} n={n} D={D} k={k} "
-                f"probe={float(jnp.sum(X[0].astype(jnp.float32))):.6e}/"
-                f"{float(jnp.sum(Y[0].astype(jnp.float32))):.6e}"
+                f"probe={data_probe(X, Y)}"
             )
             ckpt = LoopCheckpointer(self.checkpoint_path,
                                     self.checkpoint_every, fingerprint=fp)

@@ -35,6 +35,7 @@ from keystone_tpu.ops.learning.hostsolve import psd_solve_host
 from keystone_tpu.parallel.dataset import Dataset
 from keystone_tpu.utils.checkpoint import (
     LoopCheckpointer,
+    data_probe,
     two_level_schedule,
 )
 from keystone_tpu.workflow.api import Estimator, LabelEstimator, Transformer
@@ -295,8 +296,7 @@ class KernelRidgeRegression(LabelEstimator):
                 f"lam={self.lam} gamma={self.kernel_generator.gamma} "
                 f"perm={self.block_permuter} n={n} n_pad={n_pad} k={k} "
                 f"solve={self.solve} "
-                f"probe={float(jnp.sum(X[0])):.6e}/"
-                f"{float(jnp.sum(Y[0])):.6e}"
+                f"probe={data_probe(X, Y)}"
             )
             ckpt = LoopCheckpointer(self.checkpoint_path,
                                     self.checkpoint_every, fingerprint=fp)

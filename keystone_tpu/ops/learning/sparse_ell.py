@@ -87,6 +87,13 @@ def _normal_eq_pass(idx, vals, Y, *, d: int, chunk: int):
         AY = AY + jax.lax.dot_general(
             dense.T, y, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            # f32 labels keep f32 passes on the MXU — DEFAULT precision
+            # would truncate the f32 operand to bf16 (repo precision
+            # policy, block_ls._f32_mm); bf16 labels ride the native path
+            precision=(
+                jax.lax.Precision.HIGHEST
+                if y.dtype == jnp.float32 else None
+            ),
         )
         return (G, AY), None
 
@@ -95,7 +102,8 @@ def _normal_eq_pass(idx, vals, Y, *, d: int, chunk: int):
         body,
         (jnp.zeros((d, d), jnp.float32), jnp.zeros((d, k), jnp.float32)),
         (_chunked(idx, chunk), _chunked(vals, chunk),
-         _chunked(Y.astype(jnp.bfloat16), chunk)),
+         _chunked(Y, chunk)),  # Y keeps its dtype: bf16×f32→f32 accumulates
+        # without quantizing user-supplied f32 labels
     )
     return G, AY
 

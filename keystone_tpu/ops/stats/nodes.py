@@ -72,13 +72,18 @@ class PaddedFFT(Transformer):
 
 
 @partial(jax.jit, static_argnames=("pad", "thresh"))
-def _fft_bank_chunk(chunk, signs, *, pad: int, thresh: float):
+def _fft_bank_chunk(chunk, signs, mask, *, pad: int, thresh: float):
     """One fused program for a row chunk of RandomFFTFeatures — module
-    level so the jit cache is shared across instances and calls."""
+    level so the jit cache is shared across instances and calls. ``mask``
+    re-zeroes pad rows when thresh > 0 would lift them (fused, so no
+    extra full-array pass; mirrors LinearRectifier.apply_batch)."""
     f = signs.shape[0]
     xs = chunk[:, None, :] * signs[None, :, :]
     spec = jnp.real(jnp.fft.fft(xs, n=pad, axis=-1))[:, :, : pad // 2]
-    return jnp.maximum(spec, thresh).reshape(chunk.shape[0], f * (pad // 2))
+    out = jnp.maximum(spec, thresh).reshape(chunk.shape[0], f * (pad // 2))
+    if thresh > 0:
+        out = out * mask[:, None]
+    return out
 
 
 @dataclasses.dataclass(eq=False)
@@ -127,9 +132,11 @@ class RandomFFTFeatures(Transformer):
     def apply_batch(self, ds: Dataset) -> Dataset:
         x = ds.padded()
         pad = self._pad_len(x.shape[-1])
+        mask = ds.mask()
         outs = [
             _fft_bank_chunk(
                 x[s : s + self.row_chunk], self.signs,
+                mask[s : s + self.row_chunk],
                 pad=pad, thresh=self.rectify_threshold,
             )
             for s in range(0, x.shape[0], self.row_chunk)

@@ -181,4 +181,16 @@ def device_shuffle(
         out = jnp.zeros((rows_per_shard,) + rows.shape[1:], rows.dtype)
         return out.at[idx].set(rows, mode="drop")
 
-    return place(rows, slots, valid)
+    out = place(rows, slots, valid)
+    # Capacity above is exact only under contiguous block sharding of the
+    # example axis; if that assumption is ever violated, fail loudly
+    # instead of silently zeroing dropped rows. The scalar sync happens
+    # AFTER place() is dispatched, so it doesn't stall the async stream
+    # mid-pipeline (~100 ms per host sync through the remote tunnel).
+    over_count = int(over)
+    if over_count:
+        raise RuntimeError(
+            f"device_shuffle dropped {over_count} rows: the input's example"
+            " axis is not contiguously block-sharded over the mesh"
+        )
+    return out

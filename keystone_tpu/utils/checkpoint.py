@@ -100,6 +100,50 @@ class LoopCheckpointer:
                 os.remove(p)
 
 
+def data_probe(X, Y) -> str:
+    """Cheap dataset digest for checkpoint fingerprints: full sums plus a
+    few strided row sums of each operand, so a re-run on data that shares
+    row 0 but differs elsewhere (re-labeled targets, shuffled tail, ...)
+    invalidates the snapshot instead of silently resuming from it.
+
+    One jitted program, f32 accumulation via ``jnp.sum(..., dtype=...)``
+    (no materialized f32 copy of a possibly HBM-scale bf16 X), one host
+    transfer per operand."""
+    a, b = _probe_digest(X, Y)
+    fmt = lambda v: ",".join(f"{p:.6e}" for p in np.asarray(v))
+    return f"{fmt(a)}|{fmt(b)}"
+
+
+def _probe_one(A):
+    import jax.numpy as jnp
+
+    n = A.shape[0]
+    rows = [0, n // 3, (2 * n) // 3, n - 1]
+    # Row-index-weighted contraction makes the digest order-SENSITIVE
+    # (plain sums are permutation-invariant, and sampled rows can all
+    # land outside a reordered span); einsum contracts without
+    # materializing a weighted copy of a possibly HBM-scale A.
+    w = (jnp.arange(n, dtype=jnp.float32) % 97.0) + 1.0
+    sub = "nd,n->" if A.ndim == 2 else "n,n->"
+    wsum = jnp.einsum(sub, A, w, preferred_element_type=jnp.float32)
+    return jnp.stack(
+        [jnp.sum(A, dtype=jnp.float32), wsum]
+        + [jnp.sum(A[r], dtype=jnp.float32) for r in rows]
+    )
+
+
+_PROBE_JIT = None  # module-level jit: one compile cache for the process
+
+
+def _probe_digest(X, Y):
+    global _PROBE_JIT
+    if _PROBE_JIT is None:
+        import jax
+
+        _PROBE_JIT = jax.jit(lambda X, Y: (_probe_one(X), _probe_one(Y)))
+    return _PROBE_JIT(X, Y)
+
+
 def two_level_schedule(n_outer: int, n_inner: int, start=(0, 0)):
     """Iterate a resumable (sweep, block) double loop from ``start``,
     yielding ``(outer, inner, next_start)`` — ``next_start`` is the state

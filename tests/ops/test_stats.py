@@ -140,3 +140,35 @@ def test_random_fft_features_matches_composed_branches():
         np.asarray(fused.apply(jnp.asarray(x[0]))), want[0],
         rtol=1e-5, atol=1e-5,
     )
+
+
+def test_random_fft_features_nonzero_threshold_remasks_pad_rows():
+    """With rectify_threshold > 0, pad rows must stay exactly zero (the
+    Gram-based solvers sum over all padded rows assuming pads are zero),
+    and valid rows must match the composed branch path."""
+    from keystone_tpu.ops.stats import (
+        LinearRectifier, PaddedFFT, RandomFFTFeatures, RandomSignNode,
+    )
+
+    rng = np.random.default_rng(1)
+    d, f, n, pad_n = 64, 2, 5, 8
+    x = np.zeros((pad_n, d), np.float32)
+    x[:n] = rng.standard_normal((n, d)).astype(np.float32)
+    ds = Dataset.from_array(jnp.asarray(x), n=n)
+    thresh = 0.25
+
+    fused = RandomFFTFeatures.create(d, f, seed=3, rectify_threshold=thresh)
+    got = np.asarray(fused.apply_batch(ds).padded())
+    assert got.shape[0] == pad_n
+    np.testing.assert_array_equal(got[n:], 0.0)
+
+    parts = []
+    for i in range(f):
+        b = LinearRectifier(thresh).apply_batch(
+            PaddedFFT().apply_batch(
+                RandomSignNode.create(d, seed=3 + i).apply_batch(ds)
+            )
+        )
+        parts.append(np.asarray(b.padded()))
+    want = np.concatenate(parts, axis=1)
+    np.testing.assert_allclose(got[:n], want[:n], rtol=1e-5, atol=1e-5)
