@@ -99,26 +99,44 @@ def _psd_solve_device(gram, rhs, lam):
 
 
 @partial(
-    jax.jit, static_argnames=("width", "n"), donate_argnums=(1,)
+    jax.jit, static_argnames=("width", "n", "first_pass", "last_pass"),
+    donate_argnums=(1,),
 )
-def _block_step(X, R, Wb, mu_b, mask, start, lam, *, width: int, n: int):
+def _block_step(X, R, Wb, mu, mask, start, lam, *, width: int, n: int,
+                first_pass: bool = False, last_pass: bool = False):
     """One whole BCD block update — stats, solve, and residual update —
     as a single XLA program with no host synchronization. The reference's
     executor-GEMM → treeReduce → driver-LAPACK → broadcast → residual
     round trip (BlockLinearMapper.scala:234-240) becomes one dispatch.
+
+    ``first_pass``: on sweep 0 the current block's model is exactly zero
+    (fresh fit, or a resumed fit that never completed this block), so the
+    old-contribution matmul is skipped — one fewer N·b·k matmul and one
+    fewer full read of X per block on the first sweep.
+
+    ``last_pass``: after the final block of the final sweep the residual
+    is never read again, so its update (another N·b·k matmul + a full
+    residual write) is elided; the returned residual is then stale and
+    the caller must not use it.
     """
     Xb = jax.lax.dynamic_slice_in_dim(X, start, width, axis=1)
-    contrib = _f32_mm(Xb, Wb) - mask[:, None] * _f32_mm(mu_b, Wb)
-    R_plus = R + contrib
+    mu_b = jax.lax.dynamic_slice_in_dim(mu, start, width)
+    if first_pass:
+        R_plus = R
+    else:
+        contrib = _f32_mm(Xb, Wb) - mask[:, None] * _f32_mm(mu_b, Wb)
+        R_plus = R + contrib
     gram = _f32_mm(Xb.T, Xb) - n * jnp.outer(mu_b, mu_b)
     rhs = _f32_mm(Xb.T, R_plus) - jnp.outer(mu_b, jnp.sum(R_plus, axis=0))
     Wb_new = _psd_solve_device(gram, rhs, lam)
+    if last_pass:
+        return Wb_new, R_plus
     contrib_new = _f32_mm(Xb, Wb_new) - mask[:, None] * _f32_mm(mu_b, Wb_new)
     return Wb_new, R_plus - contrib_new
 
 
 @partial(jax.jit, static_argnames=("width", "n"), donate_argnums=(1,))
-def _block_stats(X, R, Wb, mu_b, mask, start, *, width: int, n: int):
+def _block_stats(X, R, Wb, mu, mask, start, *, width: int, n: int):
     """Per-block Gram pass on the RAW (possibly bf16) feature matrix.
 
     Centering is algebraic — the centered block is never materialized:
@@ -130,6 +148,7 @@ def _block_stats(X, R, Wb, mu_b, mask, start, *, width: int, n: int):
     ``start`` is traced so every equal-width block shares this compilation.
     """
     Xb = jax.lax.dynamic_slice_in_dim(X, start, width, axis=1)
+    mu_b = jax.lax.dynamic_slice_in_dim(mu, start, width)
     contrib = _f32_mm(Xb, Wb) - mask[:, None] * _f32_mm(mu_b, Wb)
     R_plus = R + contrib
     gram = _f32_mm(Xb.T, Xb) - n * jnp.outer(mu_b, mu_b)
@@ -138,8 +157,9 @@ def _block_stats(X, R, Wb, mu_b, mask, start, *, width: int, n: int):
 
 
 @partial(jax.jit, static_argnames=("width",), donate_argnums=(1,))
-def _residual_update(X, R_plus, Wb_new, mu_b, mask, start, *, width: int):
+def _residual_update(X, R_plus, Wb_new, mu, mask, start, *, width: int):
     Xb = jax.lax.dynamic_slice_in_dim(X, start, width, axis=1)
+    mu_b = jax.lax.dynamic_slice_in_dim(mu, start, width)
     contrib = _f32_mm(Xb, Wb_new) - mask[:, None] * _f32_mm(mu_b, Wb_new)
     return R_plus - contrib
 
@@ -160,6 +180,16 @@ def _centered_labels(Y, mu_y, mask):
     return (Y.astype(jnp.float32) - mu_y) * mask[:, None]
 
 
+@jax.jit
+def _prep(X, Y, mask, n):
+    """Means + centered residual in ONE dispatch (each eager/extra
+    dispatch costs real latency through a remote-tunnel device; the Y
+    pass for mu_y and the centering write share one program so XLA can
+    fuse them)."""
+    mu, mu_y = _column_means.__wrapped__(X, Y, mask, n)
+    return mu, mu_y, _centered_labels.__wrapped__(Y, mu_y, mask)
+
+
 @dataclasses.dataclass(eq=False)
 class BlockLinearMapper(Transformer):
     """Applies the block-solved linear model. Weights are stored as one
@@ -171,6 +201,9 @@ class BlockLinearMapper(Transformer):
     feature_mean: Optional[Any] = None  # (D,)
     label_mean: Optional[Any] = None  # (k,)
     explicit_intercept: Optional[Any] = None  # (k,); weighted solver sets it
+    solver_info: Optional[dict] = None  # lazy solver diagnostics (e.g.
+    # the weighted solver's PCG exit residual); values may be device
+    # scalars — reading them forces a host sync
 
     @property
     def intercept(self):
@@ -256,8 +289,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         D = X.shape[1]
         k = Y.shape[1]
         mask = data.mask()
-        mu, mu_y = _column_means(X, Y, mask, n)
-        R = _centered_labels(Y, mu_y, mask)
+        mu, mu_y, R = _prep(X, Y, mask, n)
 
         blocks = [
             (s, min(s + self.block_size, D) - s)
@@ -288,8 +320,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                     # Rebuild the residual from the compact snapshot —
                     # the lineage-truncation analogue: recompute the big
                     # intermediate instead of persisting it.
-                    mu_b = jax.lax.dynamic_slice_in_dim(mu, s, w)
-                    R = _residual_update(X, R, Wb[s], mu_b, mask, s, width=w)
+                    R = _residual_update(X, R, Wb[s], mu, mask, s, width=w)
 
         def snapshot(next_it: int, next_pos: int):
             st = {"it": next_it, "pos": next_pos}
@@ -302,24 +333,29 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             self.num_iter, len(blocks), (start_it, start_pos)
         ):
             s, w = blocks[pos]
-            mu_b = jax.lax.dynamic_slice_in_dim(mu, s, w)
             if self.solve == "device":
                 # whole block update in one dispatch; the entire fit
                 # stays in the async stream — no host sync until the
-                # caller consumes W.
+                # caller consumes W. On sweep 0 this block's model is
+                # zero in every path (including checkpoint resume: only
+                # never-completed blocks are revisited in sweep 0), so
+                # the old-contribution matmul is elided.
                 Wb[s], R = _block_step(
-                    X, R, Wb[s], mu_b, mask, s, self.lam,
-                    width=w, n=n,
+                    X, R, Wb[s], mu, mask, s, self.lam,
+                    width=w, n=n, first_pass=(it == 0),
+                    last_pass=(
+                        it == self.num_iter - 1 and pos == len(blocks) - 1
+                    ),
                 )
             else:
                 gram, rhs, R_plus = _block_stats(
-                    X, R, Wb[s], mu_b, mask, s, width=w, n=n
+                    X, R, Wb[s], mu, mask, s, width=w, n=n
                 )
                 # (b,b) solve on host in f64 (reference: driver-side
                 # NormalEquations solve) — see hostsolve.py.
                 Wb[s] = jnp.asarray(psd_solve_host(gram, rhs, self.lam))
                 R = _residual_update(
-                    X, R_plus, Wb[s], mu_b, mask, s, width=w
+                    X, R_plus, Wb[s], mu, mask, s, width=w
                 )
             done += 1
             if ckpt is not None:

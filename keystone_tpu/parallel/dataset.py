@@ -111,9 +111,15 @@ class Dataset:
         return jax.tree_util.tree_map(lambda a: a[: self._n], arrs)
 
     def mask(self) -> jnp.ndarray:
-        """(padded_n,) float32 validity mask."""
-        pn = self.padded_n
-        return (jnp.arange(pn) < self._n).astype(jnp.float32)
+        """(padded_n,) float32 validity mask (cached: solvers ask for it
+        on every fit, and each eager arange/compare dispatch costs real
+        latency on a remote-tunnel device)."""
+        m = getattr(self, "_mask", None)
+        if m is None:
+            pn = self.padded_n
+            m = (jnp.arange(pn) < self._n).astype(jnp.float32)
+            self._mask = m
+        return m
 
     def items(self) -> List[Any]:
         if self._items is not None:
