@@ -35,35 +35,69 @@ from keystone_tpu.parallel.dataset import Dataset
 from keystone_tpu.workflow.api import LabelEstimator
 
 
-@partial(jax.jit, static_argnames=("width",))
-def _class_chunk_stats(X, R, idx, wt, counts, class_ids, start, *, width):
-    """Per-class covariance/XTR for one chunk of classes.
+@partial(jax.jit, static_argnames=("G", "m", "width"))
+def _class_chunk_stats(Xg, R, wt, counts, class_ids, c0, start,
+                       *, G, m, width):
+    """Per-class covariance/XTR for one chunk of classes, reading the
+    CLASS-GROUPED feature layout.
 
-    X: (n, D) raw features; R: (n, C) residual; idx: (G, m) row indices of
-    each class's examples (padded); wt: (G, m) 0/1 validity; counts: (G,);
-    class_ids: (G,) the class index of each chunk row.
-    Returns classCov (G, b, b), classMean (G, b), classXTR (G, b),
-    resLocalMean (G,).
+    Xg: (C·m, D) features grouped by class (class c occupies rows
+    [c·m, (c+1)·m), padded slots zeroed); R: (C·m, C) residual in the
+    same row order; wt: (C, m) 0/1 validity; counts: (C,);
+    class_ids: (G,) class index of each chunk row; c0: first class of
+    the chunk. Returns classCov (G, b, b), classMean (G, b),
+    classXTR (G, b), resLocalMean (G,).
+
+    Grouping means every read here is a contiguous dynamic-slice — the
+    per-chunk row gathers this replaced were re-gathering the whole
+    dataset once per block (TPU row-gather is far below stream
+    bandwidth; measured 10 TFLOP/s on the r3 bench before this).
     """
-    Xb = jax.lax.dynamic_slice_in_dim(X, start, width, axis=1)
-    Xg = Xb[idx] * wt[:, :, None]  # (G, m, b)
-    inv = 1.0 / counts
-    class_mean = jnp.einsum("gmb->gb", Xg) * inv[:, None]
-    # HIGHEST: the centered covariance cancels mean^2-scale terms; TPU
-    # DEFAULT precision would truncate f32 operands to bf16 passes
-    # (block_ls._f32_mm documents the measured failure)
-    hp = jax.lax.Precision.HIGHEST
+    D = Xg.shape[1]
+    C = R.shape[1]
+    Xc = jax.lax.dynamic_slice(
+        Xg.reshape(-1, m, D), (c0, 0, start), (G, m, width)
+    )  # (G, m, b) — padded slots are already zero
+    wc = jax.lax.dynamic_slice(wt, (c0, 0), (G, m))
+    inv = 1.0 / jax.lax.dynamic_slice(counts, (c0,), (G,))
+    # resLocal_c = R[rows of c, c] — a (G, m, C) contiguous slice then a
+    # per-class column pick
+    Rc = jax.lax.dynamic_slice(
+        R.reshape(-1, m, C), (c0, 0, 0), (G, m, C)
+    )
+    r_g = (
+        jnp.take_along_axis(Rc, class_ids[:, None, None], axis=2)[..., 0]
+        * wc
+    )  # (G, m)
+    class_mean, class_xtr, res_local_mean = _chunk_moments(Xc, r_g, inv)
+    # HIGHEST for f32 inputs: the centered covariance cancels mean^2-
+    # scale terms; TPU DEFAULT precision would truncate f32 operands to
+    # bf16 passes (block_ls._f32_mm documents the measured failure).
+    # bf16 inputs ride the native bf16xbf16->f32 MXU path.
+    hp = (
+        jax.lax.Precision.HIGHEST
+        if Xc.dtype == jnp.float32 else None
+    )
     class_cov = (
-        jnp.einsum("gmb,gmc->gbc", Xg, Xg,
+        jnp.einsum("gmb,gmc->gbc", Xc, Xc,
                    preferred_element_type=jnp.float32, precision=hp)
         * inv[:, None, None]
         - class_mean[:, :, None] * class_mean[:, None, :]
     )
-    # resLocal_c = R[rows of c, c]
-    r_g = R[idx, class_ids[:, None]] * wt  # (G, m)
-    class_xtr = jnp.einsum("gmb,gm->gb", Xg, r_g, precision=hp) * inv[:, None]
-    res_local_mean = jnp.einsum("gm->g", r_g) * inv
     return class_cov, class_mean, class_xtr, res_local_mean
+
+
+@jax.jit
+def _group_rows(X, Y, idx, wt, joint_label_mean):
+    """ONE gather into the class-grouped layout: Xg (C·m, D) with padded
+    slots zeroed, and the initial residual R (C·m, C) = (Y − jlm)·wt in
+    the same row order. This is the only non-contiguous memory access of
+    the whole fit."""
+    flat = idx.reshape(-1)
+    w = wt.reshape(-1)
+    Xg = X[flat] * w[:, None].astype(X.dtype)
+    R = (Y[flat] - joint_label_mean[None, :]) * w[:, None]
+    return Xg, R
 
 
 @partial(jax.jit, static_argnames=("width", "n"))
@@ -99,6 +133,208 @@ def _apply_delta(X, R, delta, start, *, width):
     return R - _f32_mm(Xb, delta)
 
 
+@jax.jit
+def _precond_factor(pop_cov, w, lam):
+    """Cholesky of the shared CG preconditioner M = (1−w)·popCov +
+    (λ+ε·scale)·I. The ε jitter guards rank-deficient population
+    covariances (λ may be 0); it biases only the preconditioner, never
+    the solution."""
+    b = pop_cov.shape[0]
+    eps = 1e-6 * jnp.maximum(jnp.trace(pop_cov) / b, 1e-12)
+    M = (1.0 - w) * pop_cov + (lam + eps) * jnp.eye(b, dtype=pop_cov.dtype)
+    return jnp.linalg.cholesky(M)
+
+
+def _chunk_moments(Xc, r_g, inv):
+    """Shared per-chunk moments: classMean (G, b), classXTR (G, b),
+    resLocalMean (G,). Invariant: padded slots of Xc and r_g are ZEROED
+    by the caller (grouping or gather wrappers), so plain sums are
+    per-class sums. Precision policy: f32 accumulation everywhere; the
+    r_g contraction is always f32 (residual) -> HIGHEST."""
+    f32 = jnp.float32
+    cmean = (
+        jnp.einsum("gmb->gb", Xc, preferred_element_type=f32)
+        * inv[:, None]
+    )
+    cxtr = (
+        jnp.einsum("gmb,gm->gb", Xc, r_g,
+                   preferred_element_type=f32,
+                   precision=jax.lax.Precision.HIGHEST)
+        * inv[:, None]
+    )
+    rlm = jnp.einsum("gm->g", r_g) * inv
+    return cmean, cxtr, rlm
+
+
+def _pcg_core(Xc, inv, r_g, class_ids,
+              pop_mean, pop_cov, pop_xtr, residual_mean, L0, Wb_block,
+              w, lam, max_iters):
+    """Shared per-chunk solve core (called inside a jitted wrapper):
+    batched preconditioned CG over one chunk's classes — dW (G, b),
+    jointMean (G, b), and the exit max relative residual (scalar, for
+    convergence diagnostics).
+
+    Each class solves (jointXTX_c + λI) x = rhs_c for a SINGLE rhs
+    vector, so an exact per-class (b, b) Cholesky (b³/3 flops each, C of
+    them per block — measured to dominate the r3 weighted bench at
+    4096³) buys nothing reuse can't. Instead:
+
+    - the operator is applied matrix-free:
+        A_c v = (1−w)·popCov·v + w·(Xcᵀ(Xc v)/n_c − μ_c(μ_cᵀv))
+                + w(1−w)·δ_c(δ_cᵀv) + λv
+      so the (G, b, b) class covariances are never materialized (that
+      einsum was the other 2·N·b² of the chol path), and the Xc matvecs
+      ride the MXU as batched GEMMs;
+    - the shared preconditioner M = (1−w)·popCov + (λ+ε)I is factored
+      ONCE per block (L0) — per iteration it costs two batched
+      triangular solves. Since all A_c equal M + w·(class terms), the
+      preconditioned spectrum clusters and CG converges in tens of
+      iterations; preconditioner inexactness affects only the iteration
+      count, never the solution. The returned residual exposes the
+      ``max_iters`` cap: an ill-suited preconditioner (w→1 drains the
+      popCov term) exits with a large residual instead of failing
+      silently — fit() surfaces the max over all chunks.
+    """
+    hp = jax.lax.Precision.HIGHEST
+    f32 = jnp.float32
+
+    cmean, cxtr, rlm = _chunk_moments(Xc, r_g, inv)
+    mean_diff = cmean - pop_mean[None, :]
+    jm = cmean * w + pop_mean[None, :] * (1.0 - w)
+    mmw = jnp.take(residual_mean, class_ids) * (1.0 - w) + w * rlm
+    joint_xtr = (
+        jnp.take(pop_xtr, class_ids, axis=1).T * (1.0 - w)
+        + cxtr * w
+        - jm * mmw[:, None]
+    )
+    rhs = joint_xtr - jnp.take(Wb_block, class_ids, axis=1).T * lam
+
+    def matvec(v):  # (G, b) -> (G, b)
+        pv = (1.0 - w) * jnp.einsum(
+            "bc,gc->gb", pop_cov, v, preferred_element_type=f32,
+            precision=hp,
+        )
+        xv = jnp.einsum("gmb,gb->gm", Xc, v,
+                        preferred_element_type=f32, precision=hp)
+        xxv = jnp.einsum("gm,gmb->gb", xv, Xc,
+                         preferred_element_type=f32, precision=hp)
+        cm_dot = jnp.einsum("gb,gb->g", cmean, v, precision=hp)
+        ccov_v = xxv * inv[:, None] - cmean * cm_dot[:, None]
+        dd = (
+            mean_diff
+            * jnp.einsum("gb,gb->g", mean_diff, v, precision=hp)[:, None]
+            * (w * (1.0 - w))
+        )
+        return pv + w * ccov_v + dd + lam * v
+
+    def minv(r):  # shared-factor preconditioner, (G, b) -> (G, b)
+        y = jax.scipy.linalg.solve_triangular(L0, r.T, lower=True)
+        return jax.scipy.linalg.solve_triangular(
+            L0.T, y, lower=False
+        ).T
+
+    tiny = jnp.asarray(1e-30, f32)
+    b_norm = jnp.maximum(jnp.linalg.norm(rhs, axis=1), tiny)
+
+    def rel_res(r):
+        return jnp.max(jnp.linalg.norm(r, axis=1) / b_norm)
+
+    def cond(state):
+        it, x, r, z, p, rz = state
+        return jnp.logical_and(it < max_iters, rel_res(r) > 1e-6)
+
+    def body(state):
+        it, x, r, z, p, rz = state
+        Ap = matvec(p)
+        denom = jnp.einsum("gb,gb->g", p, Ap, precision=hp)
+        alpha = jnp.where(denom > 0, rz / jnp.maximum(denom, tiny), 0.0)
+        x = x + alpha[:, None] * p
+        r = r - alpha[:, None] * Ap
+        z = minv(r)
+        rz_new = jnp.einsum("gb,gb->g", r, z, precision=hp)
+        beta = jnp.where(rz > 0, rz_new / jnp.maximum(rz, tiny), 0.0)
+        p = z + beta[:, None] * p
+        return it + 1, x, r, z, p, rz_new
+
+    x0 = jnp.zeros_like(rhs)
+    z0 = minv(rhs)
+    rz0 = jnp.einsum("gb,gb->g", rhs, z0,
+                     precision=jax.lax.Precision.HIGHEST)
+    _, dW, r_fin, _, _, _ = jax.lax.while_loop(
+        cond, body, (jnp.asarray(0), x0, rhs, z0, z0, rz0)
+    )
+    return dW, jm, rel_res(r_fin)
+
+
+@partial(
+    jax.jit, static_argnames=("G", "m", "width", "max_iters"),
+)
+def _class_chunk_update_pcg(
+    Xg, R, wt, counts, class_ids, c0, start,
+    pop_mean, pop_cov, pop_xtr, residual_mean, L0, Wb_block, w, lam,
+    *, G, m, width, max_iters=96,
+):
+    """Grouped-layout wrapper for ``_pcg_core``: contiguous slices out
+    of the class-grouped (C·m, ·) arrays."""
+    D = Xg.shape[1]
+    C = R.shape[1]
+    Xc = jax.lax.dynamic_slice(
+        Xg.reshape(-1, m, D), (c0, 0, start), (G, m, width)
+    )
+    wc = jax.lax.dynamic_slice(wt, (c0, 0), (G, m))
+    inv = 1.0 / jax.lax.dynamic_slice(counts, (c0,), (G,))
+    Rc = jax.lax.dynamic_slice(R.reshape(-1, m, C), (c0, 0, 0), (G, m, C))
+    r_g = (
+        jnp.take_along_axis(Rc, class_ids[:, None, None], axis=2)[..., 0]
+        * wc
+    )
+    return _pcg_core(Xc, inv, r_g, class_ids, pop_mean, pop_cov,
+                     pop_xtr, residual_mean, L0, Wb_block, w, lam,
+                     max_iters)
+
+
+@partial(jax.jit, static_argnames=("m", "width", "max_iters"))
+def _class_chunk_update_pcg_gathered(
+    X, R, idx_c, wt_c, counts_c, class_ids, start,
+    pop_mean, pop_cov, pop_xtr, residual_mean, L0, Wb_block, w, lam,
+    *, m, width, max_iters=96,
+):
+    """Gathered-layout wrapper for ``_pcg_core``: used when class sizes
+    are skewed enough that padding every class to the global max would
+    blow up memory (see fit()); pads only to this chunk's own max."""
+    Xb = jax.lax.dynamic_slice_in_dim(X, start, width, axis=1)
+    Xc = Xb[idx_c] * wt_c[:, :, None].astype(Xb.dtype)
+    inv = 1.0 / counts_c
+    r_g = R[idx_c, class_ids[:, None]] * wt_c
+    return _pcg_core(Xc, inv, r_g, class_ids, pop_mean, pop_cov,
+                     pop_xtr, residual_mean, L0, Wb_block, w, lam,
+                     max_iters)
+
+
+@partial(jax.jit, static_argnames=("m", "width"))
+def _class_chunk_stats_gathered(
+    X, R, idx_c, wt_c, counts_c, class_ids, start, *, m, width,
+):
+    """Gathered-layout variant of ``_class_chunk_stats`` (same returns);
+    pads only to the chunk's own max class size."""
+    Xb = jax.lax.dynamic_slice_in_dim(X, start, width, axis=1)
+    Xc = Xb[idx_c] * wt_c[:, :, None].astype(Xb.dtype)
+    inv = 1.0 / counts_c
+    r_g = R[idx_c, class_ids[:, None]] * wt_c
+    class_mean, class_xtr, res_local_mean = _chunk_moments(Xc, r_g, inv)
+    hp = (
+        jax.lax.Precision.HIGHEST
+        if Xc.dtype == jnp.float32 else None
+    )
+    class_cov = (
+        jnp.einsum("gmb,gmc->gbc", Xc, Xc,
+                   preferred_element_type=jnp.float32, precision=hp)
+        * inv[:, None, None]
+        - class_mean[:, :, None] * class_mean[:, None, :]
+    )
+    return class_cov, class_mean, class_xtr, res_local_mean
+
+
 @dataclasses.dataclass(eq=False)
 class BlockWeightedLeastSquaresEstimator(LabelEstimator):
     """fit(features, ±1 indicator labels) -> BlockLinearMapper
@@ -110,6 +346,11 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
     mixture_weight: float
     num_features: Optional[int] = None
     class_chunk: int = 16  # classes per batched device step
+    solve: str = "auto"  # "chol": exact batched per-class Cholesky |
+    # "pcg": matrix-free preconditioned CG (skips materializing class
+    # covariances AND the C per-class b³/3 factorizations — each class
+    # has a single rhs) | "auto": pcg for wide blocks (≥1024) where the
+    # factorizations dominate, chol otherwise
 
     def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
         data = data.to_array_mode()
@@ -120,27 +361,31 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         D = X.shape[1]
         C = Y.shape[1]
         w = self.mixture_weight
-        mask = data.mask()
 
-        # -- class grouping (host, once; the groupByClasses equivalent) ---
+        # -- class grouping (the groupByClasses equivalent). Two layouts:
+        #
+        # grouped (balanced classes): ONE device gather into a padded
+        #   (C·m, ·) class-grouped copy, after which every pass is a
+        #   contiguous slice (per-chunk row-gathers were re-reading the
+        #   whole dataset once per block at far-below-stream bandwidth).
+        #   Padding every class to the global max m costs C·m − n extra
+        #   rows — fine when classes are balanced.
+        #
+        # gathered (skewed classes): when C·m would blow past ~1.5·n
+        #   (one giant class forces every class's padding), keep the
+        #   original row layout and gather each chunk's rows on the fly,
+        #   padded only to that CHUNK's own max class size.
+        #
+        # The weighted solve is row-permutation invariant, so the layout
+        # choice changes nothing numerically.
         class_of = np.asarray(jnp.argmax(Y, axis=1))[: n]
-        order = np.argsort(class_of, kind="stable")
         counts = np.bincount(class_of, minlength=C).astype(np.int64)
         # Classes with no examples get no model update (the reference's
         # groupByClasses simply yields no partition for them; the suite's
         # "empty partitions" / "1 class only" tests exercise this).
         valid_class = counts > 0
         m = int(counts.max())
-        idx = np.zeros((C, m), np.int32)
-        wt = np.zeros((C, m), np.float32)
-        off = 0
-        for c in range(C):
-            rows = order[off : off + counts[c]]
-            idx[c, : counts[c]] = rows
-            wt[c, : counts[c]] = 1.0
-            off += counts[c]
-        idx = jnp.asarray(idx)
-        wt = jnp.asarray(wt)
+        use_grouped = C * m <= int(1.5 * n) + 4096
         # clamp to 1 so empty-class divisions stay finite; their zero wt
         # rows already zero the numerators, and their delta is masked out
         counts_j = jnp.asarray(np.maximum(counts, 1), jnp.float32)
@@ -150,7 +395,29 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         joint_label_mean = jnp.asarray(
             2 * w + 2 * (1 - w) * counts / n - 1.0, jnp.float32
         )
-        R = (Y - joint_label_mean[None, :]) * mask[:, None]
+
+        rows_of = {
+            c: np.flatnonzero(class_of == c).astype(np.int32)
+            for c in range(C)
+        }
+        if use_grouped:
+            idx = np.zeros((C, m), np.int32)
+            wt = np.zeros((C, m), np.float32)
+            for c in range(C):
+                idx[c, : counts[c]] = rows_of[c]
+                wt[c, : counts[c]] = 1.0
+            idx = jnp.asarray(idx)
+            wt = jnp.asarray(wt)
+            XX, R = _group_rows(X, Y, idx, wt, joint_label_mean)
+            mask = wt.reshape(-1)
+            chunk_order = list(range(C))
+        else:
+            XX = X
+            mask = data.mask()
+            R = (Y - joint_label_mean[None, :]) * mask[:, None]
+            # chunk classes in DESCENDING size order so same-size classes
+            # share a chunk and per-chunk padding stays small
+            chunk_order = list(np.argsort(-counts, kind="stable"))
 
         blocks = [
             (s, min(s + self.block_size, D) - s)
@@ -159,49 +426,106 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         Wb = {s: jnp.zeros((wd, C), jnp.float32) for s, wd in blocks}
         joint_means = {}  # per block: (C, b)
         chunks = [
-            np.arange(g, min(g + self.class_chunk, C))
+            chunk_order[g : g + self.class_chunk]
             for g in range(0, C, self.class_chunk)
         ]
+        if not use_grouped:
+            # per-chunk gather indices, padded to the chunk's own max
+            # (pow2-rounded so compile count stays bounded)
+            chunk_idx = {}
+            for ci, chunk in enumerate(chunks):
+                mc = max(1, max(int(counts[c]) for c in chunk))
+                mc = 1 << (mc - 1).bit_length()
+                ic = np.zeros((len(chunk), mc), np.int32)
+                wc = np.zeros((len(chunk), mc), np.float32)
+                for g, c in enumerate(chunk):
+                    ic[g, : counts[c]] = rows_of[c]
+                    wc[g, : counts[c]] = 1.0
+                chunk_idx[ci] = (jnp.asarray(ic), jnp.asarray(wc), mc)
 
+        if self.solve not in ("auto", "chol", "pcg"):
+            raise ValueError(
+                f"solve must be 'auto', 'chol', or 'pcg', got {self.solve!r}"
+            )
+
+        pcg_rel = None  # max PCG exit residual across all chunk solves
         for _ in range(self.num_iter):
             for s, wd in blocks:
+                # auto: PCG where the C per-class b³/3 factorizations
+                # dominate, but not as w→1 — there the shared popCov
+                # preconditioner drains and CG may hit its iteration cap
+                use_pcg = self.solve == "pcg" or (
+                    self.solve == "auto" and wd >= 1024 and w <= 0.9
+                )
                 pop_mean, pop_cov, pop_xtr = _pop_stats(
-                    X, R, mask, s, width=wd, n=n
+                    XX, R, mask, s, width=wd, n=n
                 )
                 residual_mean = (
                     jnp.einsum("nc->c", R) / n
                 )  # MatrixUtils.computeMean over all rows
                 delta = jnp.zeros((wd, C), jnp.float32)
                 jm_block = jnp.zeros((C, wd), jnp.float32)
-                for chunk in chunks:
-                    cids = jnp.asarray(chunk, jnp.int32)
-                    ccov, cmean, cxtr, rlm = _class_chunk_stats(
-                        X, R, idx[chunk], wt[chunk], counts_j[chunk],
-                        cids, s, width=wd,
-                    )
-                    mean_diff = cmean - pop_mean[None, :]
-                    joint_xtx = (
-                        pop_cov[None] * (1.0 - w)
-                        + ccov * w
-                        + mean_diff[:, :, None]
-                        * mean_diff[:, None, :]
-                        * ((1.0 - w) * w)
-                    )
-                    jm = cmean * w + pop_mean[None, :] * (1.0 - w)
-                    mmw = residual_mean[cids] * (1.0 - w) + w * rlm
-                    joint_xtr = (
-                        pop_xtr[:, cids].T * (1.0 - w)
-                        + cxtr * w
-                        - jm * mmw[:, None]
-                    )
-                    rhs = joint_xtr - Wb[s][:, cids].T * self.lam
-                    dW = _batched_psd_solve(joint_xtx, rhs, self.lam)
+                if use_pcg:
+                    L0 = _precond_factor(pop_cov, w, self.lam)
+                for ci, chunk in enumerate(chunks):
+                    cids = jnp.asarray(np.asarray(chunk, np.int32))
+                    if use_pcg and use_grouped:
+                        dW, jm, rel = _class_chunk_update_pcg(
+                            XX, R, wt, counts_j, cids, int(chunk[0]), s,
+                            pop_mean, pop_cov, pop_xtr, residual_mean,
+                            L0, Wb[s], w, self.lam,
+                            G=len(chunk), m=m, width=wd,
+                        )
+                    elif use_pcg:
+                        ic, wc, mc = chunk_idx[ci]
+                        dW, jm, rel = _class_chunk_update_pcg_gathered(
+                            XX, R, ic, wc, counts_j[cids], cids, s,
+                            pop_mean, pop_cov, pop_xtr, residual_mean,
+                            L0, Wb[s], w, self.lam,
+                            m=mc, width=wd,
+                        )
+                    else:
+                        if use_grouped:
+                            ccov, cmean, cxtr, rlm = _class_chunk_stats(
+                                XX, R, wt, counts_j, cids, int(chunk[0]),
+                                s, G=len(chunk), m=m, width=wd,
+                            )
+                        else:
+                            ic, wc, mc = chunk_idx[ci]
+                            ccov, cmean, cxtr, rlm = (
+                                _class_chunk_stats_gathered(
+                                    XX, R, ic, wc, counts_j[cids], cids,
+                                    s, m=mc, width=wd,
+                                )
+                            )
+                        mean_diff = cmean - pop_mean[None, :]
+                        joint_xtx = (
+                            pop_cov[None] * (1.0 - w)
+                            + ccov * w
+                            + mean_diff[:, :, None]
+                            * mean_diff[:, None, :]
+                            * ((1.0 - w) * w)
+                        )
+                        jm = cmean * w + pop_mean[None, :] * (1.0 - w)
+                        mmw = residual_mean[cids] * (1.0 - w) + w * rlm
+                        joint_xtr = (
+                            pop_xtr[:, cids].T * (1.0 - w)
+                            + cxtr * w
+                            - jm * mmw[:, None]
+                        )
+                        rhs = joint_xtr - Wb[s][:, cids].T * self.lam
+                        dW = _batched_psd_solve(joint_xtx, rhs, self.lam)
+                        rel = None
+                    if rel is not None:
+                        pcg_rel = rel if pcg_rel is None else (
+                            jnp.maximum(pcg_rel, rel)
+                        )
                     v = valid_j[cids][:, None]
                     delta = delta.at[:, cids].set((dW * v).T)
                     jm_block = jm_block.at[cids].set(jm * v)
                 Wb[s] = Wb[s] + delta
                 joint_means[s] = jm_block
-                R = _apply_delta(X, R, delta, s, width=wd)
+                R = _apply_delta(XX, R, delta, s, width=wd)
 
         W = jnp.concatenate([Wb[s] for s, _ in blocks], axis=0)
         jm_full = jnp.concatenate(
@@ -210,7 +534,13 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         # finalB = jointLabelMean − Σ_d jointMeans[c,d]·W[d,c] (:311-314)
         intercept = joint_label_mean - jnp.einsum("cd,dc->c", jm_full, W)
         return BlockLinearMapper(
-            W, self.block_size, explicit_intercept=intercept
+            W, self.block_size, explicit_intercept=intercept,
+            # lazy device scalar: reading it syncs, ignoring it is free —
+            # surfaces a PCG iteration-cap exit instead of failing silently
+            solver_info=(
+                None if pcg_rel is None
+                else {"pcg_max_rel_residual": pcg_rel}
+            ),
         )
 
     @property

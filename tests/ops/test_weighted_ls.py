@@ -127,3 +127,80 @@ def test_per_class_weighted_shuffled_invariance(mesh8):
     np.testing.assert_allclose(
         np.asarray(m1.W), np.asarray(m2.W), atol=1e-3
     )
+
+
+@pytest.mark.parametrize("num_iter,block_size", [(1, 10), (2, 4)])
+def test_block_weighted_pcg_matches_reference_translation(
+    mesh8, num_iter, block_size
+):
+    """The matrix-free PCG solve path (solve="pcg") must reproduce the
+    same reference translation the Cholesky path does."""
+    X, Y, _ = _weighted_problem()
+    lam, w = 0.1, 0.6
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size, num_iter, lam, w, class_chunk=2, solve="pcg"
+    )
+    model = est.fit(Dataset.of(X).shard(), Dataset.of(Y).shard())
+    W_ref, b_ref = ref_block_weighted_bcd(X, Y, block_size, num_iter, lam, w)
+    np.testing.assert_allclose(np.asarray(model.W), W_ref, atol=2e-2)
+    np.testing.assert_allclose(
+        np.asarray(model.intercept), b_ref, atol=2e-2
+    )
+
+
+def test_block_weighted_pcg_agrees_with_chol():
+    """pcg and chol are two solvers for the same systems: their fitted
+    models must agree far tighter than either's tolerance vs f64."""
+    X, Y, _ = _weighted_problem(n=200, D=48, C=4, seed=3)
+    kw = dict(block_size=48, num_iter=1, lam=0.05, mixture_weight=0.5)
+    chol = BlockWeightedLeastSquaresEstimator(solve="chol", **kw).fit(
+        Dataset.of(X), Dataset.of(Y)
+    )
+    pcg = BlockWeightedLeastSquaresEstimator(solve="pcg", **kw).fit(
+        Dataset.of(X), Dataset.of(Y)
+    )
+    np.testing.assert_allclose(
+        np.asarray(pcg.W), np.asarray(chol.W), atol=5e-4
+    )
+
+
+def test_block_weighted_skewed_classes_gathered_layout(mesh8):
+    """Heavy class imbalance trips the gathered (per-chunk-padded)
+    layout — padding every class to the global max would blow memory.
+    Must still match the reference translation."""
+    rng = np.random.default_rng(5)
+    # counts [84, 3, 2, 1]: C*m = 336 >> 1.5*n = 135 -> gathered path
+    y = np.concatenate([
+        np.zeros(84, np.int64), np.full(3, 1), np.full(2, 2), [3],
+    ])
+    C, D = 4, 10
+    centers = rng.standard_normal((C, D)) * 2
+    X = (centers[y] + rng.standard_normal((len(y), D))).astype(np.float32)
+    Y = (2.0 * np.eye(C, dtype=np.float32)[y] - 1.0)
+    lam, w = 0.1, 0.6
+    for solve in ("chol", "pcg"):
+        est = BlockWeightedLeastSquaresEstimator(
+            10, 1, lam, w, class_chunk=2, solve=solve
+        )
+        model = est.fit(Dataset.of(X), Dataset.of(Y))
+        W_ref, b_ref = ref_block_weighted_bcd(X, Y, 10, 1, lam, w)
+        np.testing.assert_allclose(
+            np.asarray(model.W), W_ref, atol=2e-2, err_msg=solve
+        )
+        np.testing.assert_allclose(
+            np.asarray(model.intercept), b_ref, atol=2e-2, err_msg=solve
+        )
+
+
+def test_block_weighted_pcg_reports_convergence():
+    X, Y, _ = _weighted_problem(n=120, D=16, C=3, seed=2)
+    model = BlockWeightedLeastSquaresEstimator(
+        16, 1, 0.05, 0.5, solve="pcg"
+    ).fit(Dataset.of(X), Dataset.of(Y))
+    rel = float(model.solver_info["pcg_max_rel_residual"])
+    assert rel < 1e-5, rel  # converged, and the diagnostic surfaces it
+    # chol path attaches no PCG diagnostics
+    model2 = BlockWeightedLeastSquaresEstimator(
+        16, 1, 0.05, 0.5, solve="chol"
+    ).fit(Dataset.of(X), Dataset.of(Y))
+    assert model2.solver_info is None
