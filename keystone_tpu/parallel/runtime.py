@@ -48,6 +48,24 @@ DCN_AXIS = "dcn"
 _initialized = False
 
 
+def _looks_like_pod() -> bool:
+    """Whether this host appears to be one of several in a TPU pod /
+    multislice deployment — the situation where silently falling back to
+    single-host mode would make every host train its own model."""
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if "," in hosts:
+        return True
+    addrs = os.environ.get("TPU_PROCESS_ADDRESSES", "")
+    if "," in addrs:
+        return True
+    try:
+        if int(os.environ.get("MEGASCALE_NUM_SLICES", "1")) > 1:
+            return True
+    except ValueError:
+        pass
+    return False
+
+
 def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -61,6 +79,14 @@ def initialize(
     come from the arguments or the COORDINATOR_ADDRESS / NUM_PROCESSES /
     PROCESS_ID environment variables (the launch script sets these, the
     way run-pipeline.sh exported SPARK_HOME/KEYSTONE_MEM).
+
+    Failure contract: a PARTIAL explicit config (some of the three set,
+    the rest missing) raises ``ValueError`` naming what's missing; a
+    complete explicit config that fails to connect raises; with no
+    explicit config, auto-detect failure degrades to single-host ONLY
+    when the host doesn't look like part of a pod — on a configured pod
+    (worker-hostnames/multislice env present) it raises instead of
+    letting every host silently train its own model.
     """
     global _initialized
     if _initialized:
@@ -72,11 +98,36 @@ def initialize(
         num_processes = int(os.environ["NUM_PROCESSES"])
     if process_id is None and "PROCESS_ID" in os.environ:
         process_id = int(os.environ["PROCESS_ID"])
-    if coordinator_address is None and num_processes is None:
+
+    explicit = {
+        "COORDINATOR_ADDRESS": coordinator_address,
+        "NUM_PROCESSES": num_processes,
+        "PROCESS_ID": process_id,
+    }
+    given = [k for k, v in explicit.items() if v is not None]
+    missing = [k for k, v in explicit.items() if v is None]
+    if given and missing:
+        raise ValueError(
+            "partial multi-host config: "
+            f"{'/'.join(given)} set but {'/'.join(missing)} missing — "
+            "set all three of COORDINATOR_ADDRESS / NUM_PROCESSES / "
+            "PROCESS_ID (env or arguments), or none of them for "
+            "single-host / TPU-VM auto-detect"
+        )
+    if not given:
         # single-process (or TPU-VM auto-detect) path
         try:
             jax.distributed.initialize()
-        except Exception as e:  # single-host dev runs have no cluster env
+        except Exception as e:
+            if _looks_like_pod():
+                raise RuntimeError(
+                    "this host looks like part of a multi-host pod "
+                    "(TPU_WORKER_HOSTNAMES / TPU_PROCESS_ADDRESSES / "
+                    "MEGASCALE_NUM_SLICES env) but "
+                    "jax.distributed.initialize() failed — refusing to "
+                    "fall back to single-host mode, which would train a "
+                    "separate model per host"
+                ) from e
             logger.info("jax.distributed not initialized (%s); single host", e)
             _initialized = True
             return
