@@ -15,6 +15,7 @@ from keystone_tpu.ops.nlp.external import (
     POSTagger,
 )
 from keystone_tpu.ops.nlp.tagging import (
+    NEREstimator,
     PerceptronTaggerEstimator,
     rule_ner_tag,
     rule_pos_tag,
@@ -42,6 +43,7 @@ __all__ = [
     "NER",
     "NGramsHashingTF",
     "POSTagger",
+    "NEREstimator",
     "PerceptronTaggerEstimator",
     "CoreNLPFeatureExtractor",
     "NaiveBitPackIndexer",
