@@ -5,14 +5,20 @@ Prints one JSON line per metric:
 vs_baseline > 1 means faster than the reference 16-node r3.4xlarge Spark
 cluster; null where the reference published no number for the config
 (BASELINE.md: only the TIMIT/Amazon solver rows have published times).
+Solver rows additionally carry "tflops" (achieved TFLOP/s from the
+analytic FLOP count of the measured program) so MFU is tracked per
+round (v5e peak is ~197 bf16 TFLOP/s).
 
 Tracked configs (BASELINE.md "Tracked configs"):
   - TimitPipeline      -> timit_block_ls_1024_solve(+_amortized)
   - MnistRandomFFT     -> mnist_random_fft_featurize_solve
-  - RandomPatchCifar   -> random_patch_cifar_featurize imgs/sec + solve
+  - RandomPatchCifar   -> random_patch_cifar_featurize imgs/sec (the
+    app's real whitened-filter path) + solve
   - NewsgroupsPipeline -> newsgroups_train
-  - ImageNetSiftLcsFV  -> imagenet_sift_lcs_fv examples/sec/chip (north
-    star: full SIFT+LCS -> PCA -> GMM Fisher Vector featurization)
+  - ImageNetSiftLcsFV  -> imagenet_sift_lcs_fv examples/sec/chip
+    (featurize-only north star) + imagenet_sift_lcs_fv_end_to_end
+    (featurize -> weighted BCD fit -> top-5: the BASELINE.json metric)
+  - flagship solvers   -> weighted_block_ls_4096_solve, krr_block_solve
 
 Timing discipline: np.asarray(...) forces real execution —
 block_until_ready alone does not drain the remote dispatch stream on
@@ -35,18 +41,23 @@ AMAZON_EXACT_BASELINE_MS = 186_149.0  # …csv:2 (Exact, 1024 features)
 AMAZON_BEST_BASELINE_MS = 33_704.0  # …csv:4 (LS-LBFGS, their fastest)
 
 
-def emit(metric: str, value: float, unit: str, vs=None) -> None:
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(value, 2),
-                "unit": unit,
-                "vs_baseline": round(vs, 2) if vs else None,
-            }
-        ),
-        flush=True,
-    )
+_EMITTED = set()
+
+
+def emit(metric: str, value: float, unit: str, vs=None, tflops=None) -> None:
+    if metric in _EMITTED:  # a retried bench re-measures what an earlier
+        return  # attempt already emitted; duplicate rows would corrupt
+        # the driver's one-row-per-metric BENCH_r{N}.json
+    _EMITTED.add(metric)
+    row = {
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": unit,
+        "vs_baseline": round(vs, 2) if vs else None,
+    }
+    if tflops is not None:
+        row["tflops"] = round(tflops, 2)
+    print(json.dumps(row), flush=True)
 
 
 def bench_timit() -> None:
@@ -81,11 +92,20 @@ def bench_timit() -> None:
         Xd = Dataset.from_array(X, n=N)
         Yd = Dataset.from_array(Y, n=N)
 
+        # FLOPs of the measured program (num_iter=1, one 1024 block):
+        # first_pass skips the zero-model contrib matmul and last_pass
+        # skips the dead residual update, leaving gram (2·N·D²) +
+        # rhs (2·N·D·K).
+        flop = 2 * N * D * D + 2 * N * D * K
+
         est = BlockLeastSquaresEstimator(block_size=BLOCK, num_iter=1, lam=0.1)
         np.asarray(est.fit(Xd, Yd).W)  # warm compile + force exec
-        t0 = time.perf_counter()
-        np.asarray(est.fit(Xd, Yd).W)
-        single_ms = (time.perf_counter() - t0) * 1e3
+        single_ms = float("inf")  # best-of-3: the remote-tunnel round
+        # trip jitters ~100-200 ms shot to shot, swamping a single sample
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(est.fit(Xd, Yd).W)
+            single_ms = min(single_ms, (time.perf_counter() - t0) * 1e3)
 
         reps = 8
         t0 = time.perf_counter()
@@ -96,9 +116,10 @@ def bench_timit() -> None:
         amortized_ms = (time.perf_counter() - t0) * 1e3 / reps
 
     emit("timit_block_ls_1024_solve", single_ms, "ms",
-         TIMIT_BASELINE_MS / single_ms)
+         TIMIT_BASELINE_MS / single_ms, tflops=flop / single_ms / 1e9)
     emit("timit_block_ls_1024_solve_amortized", amortized_ms, "ms",
-         TIMIT_BASELINE_MS / amortized_ms)
+         TIMIT_BASELINE_MS / amortized_ms,
+         tflops=flop / amortized_ms / 1e9)
 
 
 def bench_amazon() -> None:
@@ -130,13 +151,18 @@ def bench_amazon() -> None:
     labels = Dataset.from_array(Y)
     est = EllLeastSquaresEstimator(d=D, lam=1e-2)
 
+    # tile-densified Gram + AᵀY over the dense (chunk, d) tiles: the
+    # solver really performs the dense-equivalent matmuls on the MXU
+    flop = 2 * N * D * (D + K)
+
     np.asarray(est.fit(ds, labels).W[0, 0])  # warm
     t0 = time.perf_counter()
     np.asarray(est.fit(ds, labels).W[0, 0])
     ms = (time.perf_counter() - t0) * 1e3
-    emit("amazon_ls_1024_solve", ms, "ms", AMAZON_BEST_BASELINE_MS / ms)
+    emit("amazon_ls_1024_solve", ms, "ms", AMAZON_BEST_BASELINE_MS / ms,
+         tflops=flop / ms / 1e9)
     emit("amazon_exact_1024_solve", ms, "ms",
-         AMAZON_EXACT_BASELINE_MS / ms)
+         AMAZON_EXACT_BASELINE_MS / ms, tflops=flop / ms / 1e9)
 
 
 def bench_mnist() -> None:
@@ -175,49 +201,62 @@ def bench_mnist() -> None:
 
 
 def bench_cifar() -> None:
-    """RandomPatchCifar featurization (conv 512 whitened 6x6 patches +
-    rectify + pool) throughput over CIFAR train-set-shaped data, and the
-    4096-feature BlockLS solve."""
+    """RandomPatchCifar at the app's REAL featurization path — whitened
+    random-patch filter bank (Windower patches -> normalize -> ZCA ->
+    filters, pipelines/images/random_patch_cifar.py build_filters, ref
+    RandomPatchCifar.scala:45-57), then conv + rectify + pool over the
+    CIFAR train set with the whole chunk loop inside ONE jitted
+    lax.map program (no per-chunk Python dispatch or host concat), and
+    the 4096-feature BlockLS solve."""
     from keystone_tpu.ops.images import (
-        Convolver, ImageVectorizer, Pooler, SymmetricRectifier,
+        Convolver, Pooler, SymmetricRectifier,
     )
     from keystone_tpu.ops.learning import BlockLeastSquaresEstimator
     from keystone_tpu.ops.util.nodes import ClassLabelIndicators
     from keystone_tpu.parallel.dataset import Dataset
+    from keystone_tpu.pipelines.images.random_patch_cifar import (
+        RandomCifarConfig, build_filters, synthetic_cifar,
+    )
 
     N, SIZE, F = 10_000, 32, 512
+    conf = RandomCifarConfig(num_filters=F)
+    train, _ = synthetic_cifar(n_train=2_000)
+    filters, whitener = build_filters(train.images, conf)
+
+    conv = Convolver(
+        filters, SIZE, SIZE, 3, whitener=whitener, normalize_patches=True
+    )
+    rect = SymmetricRectifier(alpha=conf.alpha)
+    pool = Pooler(conf.pool_stride, conf.pool_size)
+
     rng = np.random.default_rng(0)
     imgs = jnp.asarray(
-        rng.standard_normal((N, SIZE, SIZE, 3)).astype(np.float32)
+        rng.standard_normal((N, SIZE, SIZE, 3)).astype(np.float32) * 20
+        + 128
     )
-    filters = jnp.asarray(
-        rng.standard_normal((F, 6 * 6 * 3)).astype(np.float32)
-    )
-    feat = (
-        Convolver(filters, SIZE, SIZE, 3, normalize_patches=True)
-        .and_then(SymmetricRectifier(alpha=0.25))
-        .and_then(Pooler(13, 14))
-        .and_then(ImageVectorizer())
-    )
+    CHUNK = 500  # conv intermediate is (CHUNK, 27, 27, 2F) — HBM-bounded
 
-    CHUNK = 1000  # conv intermediate is (CHUNK, 27, 27, 2F) — HBM-bounded
+    @jax.jit
+    def featurize(imgs_chunked):
+        def one(chunk):
+            z = conv._convolve.__wrapped__(conv, chunk)
+            z = rect.apply(z)
+            z = pool._pool.__wrapped__(pool, z)
+            return jnp.transpose(z, (0, 2, 1, 3)).reshape(z.shape[0], -1)
+        return jax.lax.map(one, imgs_chunked)
 
-    def featurize():
-        outs = []
-        for s in range(0, N, CHUNK):
-            ds = Dataset.from_array(imgs[s : s + CHUNK])
-            outs.append(feat.apply(ds).get().padded())
-        return jnp.concatenate(outs, axis=0)
-
-    out = featurize()  # warm (lazy -> force)
-    np.asarray(out[:1, :1])
+    chunked = imgs.reshape(N // CHUNK, CHUNK, SIZE, SIZE, 3)
+    out = featurize(chunked)  # warm
+    np.asarray(out[:1, :1, :1])
     t0 = time.perf_counter()
-    out = featurize()
-    np.asarray(out[:1, :1])
+    out = featurize(chunked)
+    np.asarray(out[:1, :1, :1])
     dt = time.perf_counter() - t0
     emit("random_patch_cifar_featurize", N / dt, "imgs/sec")
 
-    feats = Dataset.from_array(out.astype(jnp.bfloat16), n=N)
+    feats = Dataset.from_array(
+        out.reshape(N, -1).astype(jnp.bfloat16), n=N
+    )
     y = jnp.asarray(rng.integers(0, 10, N).astype(np.int32))
     labels = ClassLabelIndicators(10).apply_batch(Dataset.from_array(y))
     est = BlockLeastSquaresEstimator(block_size=4096, num_iter=1, lam=10.0)
@@ -262,11 +301,130 @@ def bench_newsgroups() -> None:
     emit("newsgroups_train", (time.perf_counter() - t0) * 1e3, "ms")
 
 
-def bench_imagenet_fv() -> None:
-    """North star: ImageNetSiftLcsFV featurization examples/sec/chip —
-    dense multi-scale SIFT + LCS, PCA to 64 dims, 16-component GMM Fisher
-    Vectors, Hellinger + L2 normalization, at 256x256 ImageNet-like
-    resolution (reference pipeline: ImageNetSiftLcsFV.scala:106-138)."""
+def bench_weighted_ls() -> None:
+    """The flagship's ACTUAL solver: BlockWeightedLeastSquaresEstimator
+    (mixture-weighted BCD) at the ImageNetSiftLcsFV training shape per
+    chip — FV-dim features (2 branches x 2·descDim·vocabSize = 8192),
+    block size 4096 (ImageNetSiftLcsFV.scala:139-142), 128 classes,
+    262k examples (the reference published no time for this solver ->
+    vs_baseline null; this row exists so the flagship's own solver has
+    a measured number, VERDICT r2 missing #3)."""
+    from keystone_tpu.ops.learning import BlockWeightedLeastSquaresEstimator
+    from keystone_tpu.ops.util.nodes import ClassLabelIndicators
+    from keystone_tpu.parallel.dataset import Dataset
+
+    N, D, C, BLOCK = 262_144, 8192, 128, 4096
+
+    @jax.jit
+    def gen(key):
+        kx, ky = jax.random.split(key)
+        X = jax.random.normal(kx, (N, D), jnp.bfloat16)
+        y = jax.random.randint(ky, (N,), 0, C, jnp.int32)
+        return X, y
+
+    X, y = gen(jax.random.PRNGKey(0))
+    Xd = Dataset.from_array(X, n=N)
+    labels = ClassLabelIndicators(C).apply_batch(Dataset.from_array(y))
+
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size=BLOCK, num_iter=1, lam=1e-3, mixture_weight=0.5
+    )
+    np.asarray(est.fit(Xd, labels).W[:1, :1])  # warm
+    t0 = time.perf_counter()
+    model = est.fit(Xd, labels)
+    np.asarray(model.W[:1, :1])
+    ms = (time.perf_counter() - t0) * 1e3
+
+    # FLOPs of the measured (auto->PCG) path — a LOWER bound counting
+    # only its guaranteed dense passes: pop cov 2·N·b² + residual delta
+    # 2·N·b·C per block. The CG matvecs/preconditioner solves on top are
+    # iteration-count-dependent and excluded, so true utilization is
+    # somewhat higher than the emitted tflops.
+    nb = D // BLOCK
+    flop = nb * (2 * N * BLOCK**2 + 2 * N * BLOCK * C)
+    emit("weighted_block_ls_4096_solve", ms, "ms", tflops=flop / ms / 1e9)
+
+
+def bench_krr() -> None:
+    """KernelRidgeRegression block Gauss-Seidel solve at the
+    RandomPatchCifarKernel shape: 48k train rows, 1024-dim features,
+    RBF kernel, 4096-row blocks, 10 classes, one epoch
+    (KernelRidgeRegression.scala:86-235; no published reference time ->
+    vs_baseline null)."""
+    from keystone_tpu.ops.learning.kernel import (
+        GaussianKernelGenerator, KernelRidgeRegression,
+    )
+    from keystone_tpu.ops.util.nodes import ClassLabelIndicators
+    from keystone_tpu.parallel.dataset import Dataset
+
+    N, D, K, BLOCK = 49_152, 1024, 10, 4096
+
+    @jax.jit
+    def gen(key):
+        kx, ky = jax.random.split(key)
+        X = jax.random.normal(kx, (N, D), jnp.float32)
+        y = jax.random.randint(ky, (N,), 0, K, jnp.int32)
+        return X, y
+
+    X, y = gen(jax.random.PRNGKey(0))
+    Xd = Dataset.from_array(X, n=N)
+    labels = ClassLabelIndicators(K).apply_batch(Dataset.from_array(y))
+
+    est = KernelRidgeRegression(
+        kernel_generator=GaussianKernelGenerator(gamma=1e-3),
+        lam=1e-2, block_size=BLOCK, num_epochs=1,
+    )
+    np.asarray(est.fit(Xd, labels).model[:1, :1])  # warm
+    t0 = time.perf_counter()
+    model = est.fit(Xd, labels)
+    np.asarray(model.model[:1, :1])
+    ms = (time.perf_counter() - t0) * 1e3
+
+    # per block: RBF block gen 2·N·b·D + residual K_colᵀW 2·N·b·K +
+    # (b,b) Cholesky b³/3
+    nb = N // BLOCK
+    flop = nb * (2 * N * BLOCK * D + 2 * N * BLOCK * K + BLOCK**3 // 3)
+    emit("krr_block_solve", ms, "ms", tflops=flop / ms / 1e9)
+
+
+def _fixture_images(n: int, size: int) -> np.ndarray:
+    """Real ImageNet fixture images (the reference's test tar), resized
+    to ``size``² and tiled to ``n`` — SIFT work is data-dependent
+    (contrast-threshold zeroing, gradient statistics), so benching on
+    uniform noise mismeasures it (VERDICT r2 weak #7). Falls back to
+    textured synthetic images if the fixture tar is unavailable."""
+    tar = "/root/reference/src/test/resources/images/imagenet/n15075141.tar"
+    labels = "/root/reference/src/test/resources/images/imagenet-test-labels"
+    base = []
+    try:
+        from keystone_tpu.loaders.image_loaders import ImageNetLoader
+
+        for item in ImageNetLoader(tar, labels).items():
+            img = jnp.asarray(np.asarray(item.image, np.float32))
+            base.append(np.asarray(jax.image.resize(
+                img, (size, size, 3), method="bilinear"
+            )))
+    except Exception as e:
+        import sys
+        print(f"fixture images unavailable ({e}); falling back to "
+              "synthetic textures — imagenet rows are NOT comparable "
+              "to fixture-image rounds", file=sys.stderr, flush=True)
+    if not base:
+        rng = np.random.default_rng(0)
+        x, y = np.meshgrid(np.arange(size), np.arange(size))
+        for freq in (3.0, 5.0, 9.0, 17.0):
+            img = 128 + 90 * np.sin(x / freq) * np.cos(y / freq)
+            base.append(
+                np.repeat(img[:, :, None], 3, 2).astype(np.float32)
+                + rng.normal(0, 8, (size, size, 3))
+            )
+    reps = -(-n // len(base))
+    return np.stack((base * reps)[:n]).astype(np.float32)
+
+
+def _build_fv_pipeline(rng, desc_dim, vocab):
+    """The ImageNetSiftLcsFV featurization pipeline (shared by the
+    featurize-only and end-to-end benches)."""
     from keystone_tpu.ops.images.fisher_vector import FisherVector
     from keystone_tpu.ops.images.lcs import LCSExtractor
     from keystone_tpu.ops.images.sift import SIFTExtractor
@@ -277,26 +435,16 @@ def bench_imagenet_fv() -> None:
     from keystone_tpu.ops.util.nodes import (
         FloatToDouble, MatrixVectorizer, VectorCombiner,
     )
-    from keystone_tpu.parallel.dataset import Dataset
     from keystone_tpu.workflow.api import Pipeline
-
-    DESC_DIM, VOCAB, SIZE, N = 64, 16, 256, 512
-    CHUNK = 128  # bounds the (chunk, 128, ~13k) descriptor intermediates;
-    # the chunk loop keeps the dispatch stream pipelined so the ~100 ms
-    # tunnel sync amortizes over all N examples (throughput, not latency)
-    rng = np.random.default_rng(0)
-    imgs = jnp.asarray(
-        (rng.random((N, SIZE, SIZE, 3)) * 255).astype(np.float32)
-    )
 
     def branch(prefix, in_dim):
         pca = jnp.asarray(
-            rng.standard_normal((DESC_DIM, in_dim)).astype(np.float32) * 0.1
+            rng.standard_normal((desc_dim, in_dim)).astype(np.float32) * 0.1
         )
         gmm = GaussianMixtureModel(
-            jnp.asarray(rng.standard_normal((DESC_DIM, VOCAB)), jnp.float32),
-            jnp.ones((DESC_DIM, VOCAB), jnp.float32),
-            jnp.ones((VOCAB,), jnp.float32) / VOCAB,
+            jnp.asarray(rng.standard_normal((desc_dim, vocab)), jnp.float32),
+            jnp.ones((desc_dim, vocab), jnp.float32),
+            jnp.ones((vocab,), jnp.float32) / vocab,
         )
         return (
             prefix
@@ -316,7 +464,24 @@ def bench_imagenet_fv() -> None:
         128,
     )
     lcs = branch(LCSExtractor(4, 16, 6).to_pipeline(), 96)
-    pipe = Pipeline.gather([sift, lcs]).and_then(VectorCombiner())
+    return Pipeline.gather([sift, lcs]).and_then(VectorCombiner())
+
+
+def bench_imagenet_fv() -> None:
+    """North star (featurize): ImageNetSiftLcsFV featurization
+    examples/sec/chip — dense multi-scale SIFT + LCS, PCA to 64 dims,
+    16-component GMM Fisher Vectors, Hellinger + L2 normalization, at
+    256x256 ImageNet-like resolution (reference pipeline:
+    ImageNetSiftLcsFV.scala:106-138)."""
+    from keystone_tpu.parallel.dataset import Dataset
+
+    SIZE, N = 256, 512
+    CHUNK = 128  # bounds the (chunk, 128, ~13k) descriptor intermediates;
+    # the chunk loop keeps the dispatch stream pipelined so the ~100 ms
+    # tunnel sync amortizes over all N examples (throughput, not latency)
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(_fixture_images(N, SIZE))
+    pipe = _build_fv_pipeline(rng, 64, 16)
 
     def run_once():
         last = None
@@ -332,13 +497,79 @@ def bench_imagenet_fv() -> None:
     emit("imagenet_sift_lcs_fv_featurize", N / dt, "examples/sec/chip")
 
 
+def bench_imagenet_e2e() -> None:
+    """North star (END TO END, the BASELINE.json metric): featurize ->
+    BlockWeightedLeastSquaresEstimator(4096) fit -> top-5 prediction,
+    examples/sec/chip over the full train pass (reference:
+    ImageNetSiftLcsFV.scala:82-148 — featurize + weighted BCD solve +
+    TopKClassifier(5))."""
+    from keystone_tpu.ops.learning import BlockWeightedLeastSquaresEstimator
+    from keystone_tpu.ops.util.nodes import ClassLabelIndicators, TopKClassifier
+    from keystone_tpu.parallel.dataset import Dataset
+
+    SIZE, N, C = 256, 512, 100
+    CHUNK = 128
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(_fixture_images(N, SIZE))
+    y = jnp.asarray(rng.integers(0, C, N).astype(np.int32))
+    pipe = _build_fv_pipeline(rng, 64, 16)
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size=4096, num_iter=1, lam=1e-3, mixture_weight=0.5
+    )
+    top5 = TopKClassifier(5)
+
+    def run_once():
+        chunks = [
+            pipe.apply(Dataset.from_array(imgs[s : s + CHUNK]))
+            .get().padded()
+            for s in range(0, N, CHUNK)
+        ]
+        feats = Dataset.from_array(jnp.concatenate(chunks, axis=0), n=N)
+        labels = ClassLabelIndicators(C).apply_batch(Dataset.from_array(y))
+        model = est.fit(feats, labels)
+        preds = top5.apply_batch(model.apply_batch(feats))
+        np.asarray(preds.padded()[:1])
+
+    run_once()  # warm
+    t0 = time.perf_counter()
+    run_once()
+    dt = time.perf_counter() - t0
+    emit("imagenet_sift_lcs_fv_end_to_end", N / dt, "examples/sec/chip")
+
+
 def main() -> None:
-    bench_timit()
-    bench_amazon()
-    bench_mnist()
-    bench_cifar()
-    bench_newsgroups()
-    bench_imagenet_fv()
+    import sys
+
+    # persistent XLA executable cache: reruns (and the driver's
+    # end-of-round run) skip the ~20-40s-per-program remote compiles
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/tmp/kstpu_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax without the knobs
+
+    benches = [
+        bench_timit,
+        bench_amazon,
+        bench_mnist,
+        bench_cifar,
+        bench_newsgroups,
+        bench_weighted_ls,
+        bench_krr,
+        bench_imagenet_fv,
+        bench_imagenet_e2e,
+    ]
+    for b in benches:
+        # one attempt + one retry: the remote-compile tunnel occasionally
+        # drops a response mid-read; a transient flake must not cost the
+        # round every remaining metric
+        for attempt in (0, 1):
+            try:
+                b()
+                break
+            except Exception as e:
+                print(f"{b.__name__} attempt {attempt} failed: {e}",
+                      file=sys.stderr, flush=True)
 
 
 if __name__ == "__main__":

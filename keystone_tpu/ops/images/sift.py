@@ -163,7 +163,7 @@ class SIFTExtractor(Transformer):
     step: int = 3
     bin: int = 4
     num_scales: int = 4
-    scale_step: int = 0
+    scale_step: int = 1  # reference default (SIFTExtractor.scala:16)
     vmap_batch = False  # ragged across shapes
     bucket_vmap = True  # but vmappable within a shape bucket
 

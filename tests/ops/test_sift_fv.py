@@ -57,8 +57,9 @@ def test_sift_descriptor_count_matches_formula():
         b = 4 + 2 * s
         bound = (1 + 2 * num_scales) - 3 * s
         extent = 3 * b
-        nfy = (H - 1 - bound - extent) // 3 + 1
-        nfx = (W - 1 - bound - extent) // 3 + 1
+        step_s = 3 + s  # default scale_step=1 (SIFTExtractor.scala:16)
+        nfy = (H - 1 - bound - extent) // step_s + 1
+        nfx = (W - 1 - bound - extent) // step_s + 1
         expected += nfy * nfx
     assert out.shape[1] == expected
 
