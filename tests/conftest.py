@@ -33,6 +33,20 @@ else:
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(config, items):
+    """One shared gate for @pytest.mark.needs_mesh8 — sharded tests skip
+    on single-chip hardware (the KEYSTONE_TPU_TEST_REAL sweep) instead of
+    each module rolling its own skipif."""
+    import jax
+
+    if len(jax.devices()) >= 8:
+        return
+    skip = pytest.mark.skip(reason="needs the 8-device (virtual) mesh")
+    for item in items:
+        if "needs_mesh8" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture(autouse=True)
 def reset_pipeline_env():
     """Each test gets a fresh global pipeline environment (reference:

@@ -4,11 +4,10 @@ one-pass normal equations vs dense exact solve, sharded mesh8 path."""
 import jax
 import pytest as _pytest
 
-# Only the sharded tests need the 8-way mesh; the single-device ELL
-# correctness tests must still run in the real-hardware sweep.
-mesh8 = _pytest.mark.skipif(
-    len(jax.devices()) < 8, reason="needs the 8-device (virtual) mesh"
-)
+# Only the sharded tests need the 8-way mesh (shared needs_mesh8 gate in
+# tests/conftest.py); the single-device ELL correctness tests must still
+# run in the real-hardware sweep.
+mesh8 = _pytest.mark.needs_mesh8
 
 
 import jax.numpy as jnp

@@ -16,10 +16,8 @@ from keystone_tpu.parallel.runtime import (
 )
 
 # The 2x2x2 multislice mesh needs 8 devices — present on the virtual CPU
-# mesh, absent on a single real chip (KEYSTONE_TPU_TEST_REAL sweep)
-mesh8 = pytest.mark.skipif(
-    len(jax.devices()) < 8, reason="needs the 8-device (virtual) mesh"
-)
+# mesh, absent on a single real chip (shared gate in tests/conftest.py)
+mesh8 = pytest.mark.needs_mesh8
 
 
 def test_multislice_shape_logic():

@@ -1,0 +1,10 @@
+"""Shared helpers for the graph suites (importable because
+pytest puts each test dir on sys.path in rootdir mode)."""
+
+from keystone_tpu.workflow.operators import DatumOperator
+
+
+def op(name):
+    """A labeled constant-datum operator — the graph suites' stand-in
+    node payload."""
+    return DatumOperator(name, label=name)

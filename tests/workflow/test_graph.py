@@ -13,11 +13,7 @@ from keystone_tpu.workflow.graph import (
     get_parents,
     linearize,
 )
-from keystone_tpu.workflow.operators import DatumOperator
-
-
-def op(name):
-    return DatumOperator(name, label=name)
+from graph_test_helpers import op
 
 
 def chain3():

@@ -1,0 +1,273 @@
+"""Exhaustive Graph IR surgery semantics — the accessor/mutator edge
+cases of the reference's GraphSuite (786 LoC, workflow/Graph.scala per-op
+contracts) expressed against this IR: accessor failures, every surgery
+op's success + error paths, id-allocation rules, and splice argument
+checks. Complements tests/workflow/test_graph.py (happy paths)."""
+
+import pytest
+
+from keystone_tpu.workflow.graph import (
+    EMPTY_GRAPH,
+    NodeId,
+    SinkId,
+    SourceId,
+    linearize,
+)
+from graph_test_helpers import op
+
+
+def diamond():
+    """src -> a -> (b, c) -> d -> sink (b, c are parallel branches)."""
+    g, src = EMPTY_GRAPH.add_source()
+    g, a = g.add_node(op("a"), (src,))
+    g, b = g.add_node(op("b"), (a,))
+    g, c = g.add_node(op("c"), (a,))
+    g, d = g.add_node(op("d"), (b, c))
+    g, snk = g.add_sink(d)
+    return g, src, a, b, c, d, snk
+
+
+# -- accessors ------------------------------------------------------------
+
+
+def test_accessors_on_missing_ids_raise():
+    g, src, a, b, c, d, snk = diamond()
+    with pytest.raises(KeyError):
+        g.get_operator(NodeId(99))
+    with pytest.raises(KeyError):
+        g.get_dependencies(NodeId(99))
+    with pytest.raises(KeyError):
+        g.get_sink_dependency(SinkId(99))
+
+
+def test_accessors_return_structure():
+    g, src, a, b, c, d, snk = diamond()
+    assert g.get_dependencies(d) == (b, c)
+    assert g.get_sink_dependency(snk) == d
+    assert g.get_operator(a).label == "a"
+    assert g.nodes == {a, b, c, d}
+    assert g.sinks == {snk}
+    assert g.sources == frozenset({src})
+
+
+# -- add ops on the empty graph ------------------------------------------
+
+
+def test_add_node_on_empty_graph_no_deps():
+    g, n = EMPTY_GRAPH.add_node(op("n"), ())
+    assert g.nodes == {n}
+    assert g.get_dependencies(n) == ()
+    assert g.sources == frozenset()
+
+
+def test_add_source_on_empty_graph():
+    g, s = EMPTY_GRAPH.add_source()
+    assert g.sources == frozenset({s})
+    assert g.nodes == set()
+    # a sink may depend directly on a source
+    g, snk = g.add_sink(s)
+    assert g.get_sink_dependency(snk) == s
+
+
+def test_id_allocation_monotone_and_disjoint_per_kind():
+    g, s0 = EMPTY_GRAPH.add_source()
+    g, s1 = g.add_source()
+    g, n0 = g.add_node(op("x"), (s0,))
+    g, k0 = g.add_sink(n0)
+    assert (s0.id, s1.id) == (0, 1)
+    assert n0.id == 0 and k0.id == 0  # kinds number independently
+    # ids are max+1 over the CURRENT population (reference semantics:
+    # Graph.scala nextId = max + 1), so removing the only sink lets its
+    # id be reused — safe because all surgery is functional
+    g2 = g.remove_sink(k0)
+    g2, k1 = g2.add_sink(n0)
+    assert k1.id == k0.id
+
+
+# -- setters --------------------------------------------------------------
+
+
+def test_set_dependencies():
+    g, src, a, b, c, d, snk = diamond()
+    g2 = g.set_dependencies(d, (c, b))
+    assert g2.get_dependencies(d) == (c, b)
+    assert g.get_dependencies(d) == (b, c)  # original untouched
+    with pytest.raises(KeyError):
+        g.set_dependencies(NodeId(99), (a,))
+
+
+def test_set_operator():
+    g, src, a, b, c, d, snk = diamond()
+    g2 = g.set_operator(b, op("b2"))
+    assert g2.get_operator(b).label == "b2"
+    assert g.get_operator(b).label == "b"
+    with pytest.raises(KeyError):
+        g.set_operator(NodeId(99), op("x"))
+
+
+def test_set_sink_dependency():
+    g, src, a, b, c, d, snk = diamond()
+    g2 = g.set_sink_dependency(snk, b)
+    assert g2.get_sink_dependency(snk) == b
+    assert g.get_sink_dependency(snk) == d
+    with pytest.raises(KeyError):
+        g.set_sink_dependency(SinkId(99), b)
+
+
+# -- removals -------------------------------------------------------------
+
+
+def test_remove_sink_leaves_nodes():
+    g, src, a, b, c, d, snk = diamond()
+    g2 = g.remove_sink(snk)
+    assert g2.sinks == set()
+    assert g2.nodes == {a, b, c, d}
+    with pytest.raises(KeyError):
+        g.remove_sink(SinkId(99))
+
+
+def test_remove_source_requires_unreferenced():
+    g, src, a, b, c, d, snk = diamond()
+    with pytest.raises(ValueError):
+        g.remove_source(src)  # a still depends on it
+    g2 = g.set_dependencies(a, ())
+    g3 = g2.remove_source(src)
+    assert g3.sources == frozenset()
+
+
+def test_remove_node_requires_unreferenced():
+    g, src, a, b, c, d, snk = diamond()
+    with pytest.raises(ValueError):
+        g.remove_node(b)  # d still depends on it
+    with pytest.raises(ValueError):
+        g.remove_node(d)  # the sink still depends on it
+    g2 = g.remove_sink(snk).set_dependencies(d, ())
+    g3 = g2.remove_node(d)
+    assert d not in g3.nodes
+
+
+def test_replace_dependency_rewrites_nodes_and_sinks():
+    g, src, a, b, c, d, snk = diamond()
+    # reroute every consumer of b onto c; b becomes dead
+    g2 = g.replace_dependency(b, c)
+    assert g2.get_dependencies(d) == (c, c)
+    g3 = g2.set_sink_dependency(snk, b).replace_dependency(b, a)
+    assert g3.get_sink_dependency(snk) == a
+
+
+# -- graph composition ----------------------------------------------------
+
+
+def test_add_graph_remaps_without_collisions():
+    g1, src1, a1, b1, c1, d1, snk1 = diamond()
+    g2, src2, a2, b2, c2, d2, snk2 = diamond()
+    merged, smap, kmap = g1.add_graph(g2)
+    # old structure intact
+    assert merged.get_dependencies(d1) == (b1, c1)
+    # imported structure intact under fresh ids
+    new_src = smap[src2]
+    new_snk = kmap[snk2]
+    assert new_src != src1 and new_snk != snk1
+    assert len(merged.nodes) == 8
+    assert len(merged.sources) == 2
+    # imported sink resolves through remapped nodes back to its source
+    tip = merged.get_sink_dependency(new_snk)
+    assert tip in merged.nodes and tip != d1
+
+
+def test_connect_graph_missing_splice_ids_raise():
+    g1, src1, a1, b1, c1, d1, snk1 = diamond()
+    g2, src2, a2, b2, c2, d2, snk2 = diamond()
+    with pytest.raises(KeyError):
+        g1.connect_graph(g2, {SourceId(99): snk1})
+    with pytest.raises(KeyError):
+        g1.connect_graph(g2, {src2: SinkId(99)})
+
+
+def test_connect_graph_removes_spliced_endpoints():
+    g1, src1, a1, b1, c1, d1, snk1 = diamond()
+    g2, src2, a2, b2, c2, d2, snk2 = diamond()
+    merged, smap, kmap = g1.connect_graph(g2, {src2: snk1})
+    # the spliced source and sink are gone; the imported head now feeds
+    # from g1's old tip
+    assert src2 not in smap  # consumed by the splice
+    assert snk1 not in merged.sinks
+    assert len(merged.sources) == 1
+    remapped_heads = [
+        n for n in merged.nodes
+        if merged.get_dependencies(n) and
+        merged.get_dependencies(n)[0] == d1 and n not in (b1, c1, d1)
+    ]
+    assert remapped_heads  # g2's `a` now consumes g1's `d`
+
+
+def test_replace_nodes_missing_ids_raise():
+    g1, src1, a1, b1, c1, d1, snk1 = diamond()
+    rep, rsrc = EMPTY_GRAPH.add_source()
+    rep, rn = rep.add_node(op("r"), (rsrc,))
+    rep, rsnk = rep.add_sink(rn)
+    with pytest.raises(KeyError):
+        g1.replace_nodes({b1}, rep, {SourceId(99): a1}, {b1: rsnk})
+    with pytest.raises(KeyError):
+        g1.replace_nodes({b1}, rep, {rsrc: a1}, {b1: SinkId(99)})
+
+
+def test_linearize_topological_and_deterministic():
+    g, src, a, b, c, d, snk = diamond()
+    order = linearize(g)
+    pos = {gid: i for i, gid in enumerate(order)}
+    assert pos[src] < pos[a] < pos[d]
+    assert pos[a] < pos[b] and pos[a] < pos[c]
+    assert order == linearize(g)  # deterministic
+
+
+# -- analyses (reference AnalysisUtilsSuite depth) ------------------------
+
+
+def test_children_and_parents():
+    from keystone_tpu.workflow.graph import get_children, get_parents
+
+    g, src, a, b, c, d, snk = diamond()
+    assert get_children(g, src) == {a}
+    assert get_children(g, a) == {b, c}
+    assert get_children(g, d) == {snk}
+    assert get_parents(g, a) == {src}
+    assert get_parents(g, d) == {b, c}
+    assert get_parents(g, snk) == {d}
+    assert get_parents(g, src) == set()
+
+
+def test_descendants_and_ancestors():
+    from keystone_tpu.workflow.graph import get_ancestors, get_descendants
+
+    g, src, a, b, c, d, snk = diamond()
+    assert get_descendants(g, src) == {a, b, c, d, snk}
+    assert get_descendants(g, b) == {d, snk}
+    assert get_descendants(g, d) == {snk}
+    assert get_ancestors(g, snk) == {src, a, b, c, d}
+    assert get_ancestors(g, d) == {src, a, b, c}
+    assert get_ancestors(g, a) == {src}
+    assert get_ancestors(g, src) == set()
+
+
+def test_analyses_on_disconnected_components():
+    from keystone_tpu.workflow.graph import get_ancestors, get_descendants
+
+    g, src, a, b, c, d, snk = diamond()
+    g, lone = g.add_node(op("lone"), ())
+    assert get_descendants(g, lone) == set()
+    assert get_ancestors(g, lone) == set()
+    # the diamond is unaffected
+    assert get_descendants(g, b) == {d, snk}
+
+
+def test_linearize_is_sink_reachable_only():
+    """Nodes that feed no sink are excluded (reference AnalysisUtils
+    .linearize walks back from sinks — the property dead-branch removal
+    keys on)."""
+    g, src, a, b, c, d, snk = diamond()
+    g, lone = g.add_node(op("lone"), ())
+    order = linearize(g)
+    assert len(order) == len(set(order))
+    assert set(order) >= {src, a, b, c, d}
+    assert lone not in order

@@ -173,3 +173,50 @@ def test_apply_pipeline_dataset_chains_lazily():
     stage2 = AddConst(1.0)(stage1)
     out = stage2.get()
     np.testing.assert_allclose(np.asarray(out.array()), 4 * np.ones((2, 2)))
+
+
+def test_incremental_extension_reuses_executed_prefix():
+    """Reference PipelineSuite 'Incrementally update execution state':
+    extending an already-executed pipeline with and_then must not refit
+    the earlier estimator — its prefix is already in PipelineEnv state."""
+    data = Dataset.from_array(jnp.asarray([[1.0], [3.0]]))
+    est = MeanCenterEstimator()
+    pipe = Scale(1.0).and_then(est, data)
+    pipe.apply_datum(jnp.asarray([5.0])).get()
+    assert est.fit_count == 1
+
+    extended = pipe.and_then(Scale(10.0))
+    out = extended.apply_datum(jnp.asarray([5.0])).get()
+    np.testing.assert_allclose(out, [30.0])
+    assert est.fit_count == 1  # prefix reused, not refit
+
+
+def test_incremental_extension_with_label_estimator():
+    data = Dataset.from_array(jnp.zeros((3, 1)))
+    labels = Dataset.from_array(jnp.ones((3, 1)))
+    est = OffsetLabelEstimator()
+    pipe = Scale(1.0).and_then(est, data, labels)
+    pipe.apply_datum(jnp.asarray([0.0])).get()
+
+    extended = pipe.and_then(AddConst(5.0))
+    out = extended.apply_datum(jnp.asarray([0.0])).get()
+    np.testing.assert_allclose(out, [6.0])
+    assert est.fit_count == 1
+
+
+def test_incremental_second_estimator_fits_on_first_output():
+    """Chaining a SECOND estimator whose training data flows through the
+    first: the first stays fit-once, the second sees transformed data."""
+    data = Dataset.from_array(jnp.asarray([[2.0], [4.0]]))
+    est1 = MeanCenterEstimator()
+    pipe = Scale(1.0).and_then(est1, data)
+    pipe.apply_datum(jnp.asarray([1.0])).get()
+
+    est2 = MeanCenterEstimator()
+    # est2 trains on est1's OUTPUT of the same data (mean 0 after
+    # centering), so its learned offset is 0
+    extended = pipe.and_then(est2, data)
+    out = extended.apply_datum(jnp.asarray([1.0])).get()
+    np.testing.assert_allclose(out, [-2.0])  # 1 - mean(3) + 0
+    assert est1.fit_count == 1
+    assert est2.fit_count == 1
