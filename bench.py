@@ -122,6 +122,50 @@ def bench_timit() -> None:
          tflops=flop / amortized_ms / 1e9)
 
 
+TIMIT_LBFGS_BASELINE_MS = 70_396.0  # …csv:15 (LS-LBFGS, 1024 features)
+
+
+def bench_timit_lbfgs() -> None:
+    """Fused device L-BFGS at the TIMIT shape (2.25M x 1024, 147
+    classes, 20 iterations — reference row: 70,396 ms on the cluster,
+    scripts/solver-comparisons-final.csv:15). The whole optimization
+    (two-loop recursion + Armijo line search) runs as ONE device
+    program (ops/learning/lbfgs.py run_lbfgs_device)."""
+    from keystone_tpu.ops.learning.lbfgs import DenseLBFGSwithL2
+    from keystone_tpu.parallel.dataset import Dataset
+
+    N, D, K = 2_251_569, 1024, 147
+
+    @jax.jit
+    def gen(key):
+        kx, kw = jax.random.split(key)
+        X = jax.random.normal(kx, (N, D), jnp.bfloat16)
+        W = jax.random.normal(kw, (D, K), jnp.bfloat16) * 0.1
+        Y = jax.lax.dot_general(
+            X, W, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return X, Y
+
+    X, Y = gen(jax.random.PRNGKey(0))
+    Xd = Dataset.from_array(X, n=N)
+    Yd = Dataset.from_array(Y, n=N)
+    est = DenseLBFGSwithL2(
+        num_iterations=20, reg_param=1e-4, fit_intercept=False
+    )
+
+    # LOWER bound: one value+grad per iteration (forward 2NDK + backward
+    # 2NDK); Armijo re-evaluations on top are data-dependent
+    flop = est.num_iterations * 4 * N * D * K
+
+    np.asarray(est.fit(Xd, Yd).W[:1, :1])  # warm
+    t0 = time.perf_counter()
+    np.asarray(est.fit(Xd, Yd).W[:1, :1])
+    ms = (time.perf_counter() - t0) * 1e3
+    emit("timit_lbfgs_1024_solve", ms, "ms",
+         TIMIT_LBFGS_BASELINE_MS / ms, tflops=flop / ms / 1e9)
+
+
 def bench_amazon() -> None:
     """Amazon reviews solver row at the reference experiment's shape:
     65M examples x 1024 hashed-TF features, ~0.5% dense (nnz=5/row),
@@ -550,6 +594,7 @@ def main() -> None:
 
     benches = [
         bench_timit,
+        bench_timit_lbfgs,
         bench_amazon,
         bench_mnist,
         bench_cifar,
