@@ -5,48 +5,30 @@ via a WNID->class map file), loaders/ImageLoaderUtils.scala:22-47
 (per-file tar streaming + decode), loaders/VOCLoader.scala:15 (VOC2007
 multi-label tar loader + voclabels.csv).
 
-Host-side streaming IO feeding device arrays — the input-pipeline side of
-the framework. Images decode to (x=row, y=col, c) float arrays.
+These are the EAGER loaders (materialize a ``Dataset`` of decoded
+images) for datasets that fit in host RAM — tests, CIFAR-scale work,
+fixture tars. They are thin collectors over the out-of-core streaming
+substrate in ``loaders/streaming.py``; at ImageNet scale use
+``StreamingImageNetLoader`` directly and never materialize.
+
+Images decode to (x=row, y=col, c) float arrays.
 """
 
 from __future__ import annotations
 
-import csv
 import dataclasses
-import io
-import os
-import tarfile
-from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from keystone_tpu.loaders.streaming import (
+    StreamingImageLoader,
+    imagenet_label_fn,
+    tar_shard_paths,
+    voc_label_fn,
+)
 from keystone_tpu.parallel.dataset import Dataset
 
 NUM_IMAGENET_CLASSES = 1000
-
-
-def _decode(data: bytes) -> Optional[np.ndarray]:
-    from PIL import Image as PILImage
-
-    try:
-        img = PILImage.open(io.BytesIO(data))
-        img = img.convert("RGB")
-        return np.asarray(img, dtype=np.float32)
-    except Exception:
-        return None
-
-
-def _iter_tar_images(path: str):
-    with tarfile.open(path) as tf:
-        for member in tf:
-            if not member.isfile():
-                continue
-            f = tf.extractfile(member)
-            if f is None:
-                continue
-            arr = _decode(f.read())
-            if arr is not None:
-                yield member.name, arr
 
 
 @dataclasses.dataclass
@@ -56,55 +38,30 @@ class LabeledImage:
     filename: str = ""
 
 
-def _tar_paths(location: str) -> List[str]:
-    if os.path.isdir(location):
-        return sorted(
-            os.path.join(location, f)
-            for f in os.listdir(location)
-            if f.endswith(".tar")
-        )
-    return [location]
-
-
 def ImageNetLoader(location: str, labels_path: str) -> Dataset:
     """Load labeled ImageNet images from tar archive(s). ``labels_path``
     maps WNID -> integer class ("n15075141 12" lines, reference:
     ImageNetLoader.scala label map)."""
-    label_map: Dict[str, int] = {}
-    with open(labels_path) as f:
-        for line in f:
-            parts = line.split()
-            if len(parts) >= 2:
-                label_map[parts[0]] = int(parts[1])
-    items: List[LabeledImage] = []
-    for tar in _tar_paths(location):
-        for name, arr in _iter_tar_images(tar):
-            wnid = name.split("/")[0].split("_")[0]
-            label = label_map.get(wnid)
-            if label is None:
-                continue
-            items.append(LabeledImage(arr, label, name))
-    return Dataset.from_items(items)
+    stream = StreamingImageLoader(
+        tar_shard_paths(location, 0, 1), imagenet_label_fn(labels_path)
+    )
+    return Dataset.from_items(
+        [LabeledImage(arr, label, name) for name, label, arr in stream.items()]
+    )
 
 
 def VOCLoader(location: str, labels_path: str) -> Dataset:
     """VOC2007 loader: labels CSV has (id, class, classname, traintesteval,
     filename) rows; an image may appear under several classes (multi-label,
     reference: VOCLoader.scala:15)."""
-    by_file: Dict[str, List[int]] = {}
-    with open(labels_path) as f:
-        for row in csv.DictReader(f):
-            fname = row["filename"].split("/")[-1]
-            by_file.setdefault(fname, []).append(int(row["class"]) - 1)
-    items: List[LabeledImage] = []
-    for tar in _tar_paths(location):
-        for name, arr in _iter_tar_images(tar):
-            fname = name.split("/")[-1]
-            if fname in by_file:
-                items.append(
-                    LabeledImage(arr, -1, fname)
-                )
-                items[-1].labels = by_file[fname]  # multi-label
+    stream = StreamingImageLoader(
+        tar_shard_paths(location, 0, 1), voc_label_fn(labels_path)
+    )
+    items = []
+    for name, labels, arr in stream.items():
+        li = LabeledImage(arr, -1, name.split("/")[-1])
+        li.labels = labels  # multi-label
+        items.append(li)
     return Dataset.from_items(items)
 
 

@@ -1,0 +1,269 @@
+"""Out-of-core streaming image input pipeline.
+
+Reference: loaders/ImageLoaderUtils.scala:22-47 — the reference never
+materializes a dataset: it builds an RDD of tar-file paths, and each
+executor streams its assigned tar archives member-by-member, decoding
+one image at a time. ImageNetLoader.scala:11 / VOCLoader.scala:15 are
+thin label-mapping wrappers over that stream.
+
+TPU-native equivalent (no RDD): a host-side bounded pipeline per
+process —
+
+    tar paths ──(per-process shard: paths[rank::world])──▶ member bytes
+      ──(window of decode futures, order-preserving)──▶ decoded arrays
+      ──(fixed-shape assembly)──▶ (B, H, W, 3) float32 batches + labels
+
+Memory is bounded by construction: at most ``decode_window`` raw/decoded
+images plus one assembly batch are alive at any time, independent of the
+dataset size — full ImageNet streams through a few hundred MB of host
+RAM instead of the ~250 GB an eager load needs. Multi-host sharding is
+by tar file, round-robin on ``jax.process_index()`` (the analogue of the
+reference's file-path RDD partitioning): shards are disjoint and their
+union is the whole dataset, so shard-and-sum statistics (Gram matrices,
+label counts — everything the solvers consume) equal the single-reader
+result exactly.
+
+Decode uses PIL's JPEG draft mode when a target size is given: the DCT
+can be decoded at 1/2, 1/4, 1/8 scale nearly for free, so a 256² target
+skips most of the inverse transform of a full-resolution photo — decode
+is the host bottleneck at ImageNet scale, and draft mode is the
+difference between the pipeline feeding the chip or starving it.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import os
+import tarfile
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "StreamingImageLoader",
+    "StreamingImageNetLoader",
+    "StreamingVOCLoader",
+    "imagenet_label_fn",
+    "voc_label_fn",
+    "tar_shard_paths",
+]
+
+
+def tar_shard_paths(
+    location: str,
+    shard_index: Optional[int] = None,
+    num_shards: Optional[int] = None,
+) -> List[str]:
+    """Tar files under ``location`` assigned to this process's shard,
+    round-robin by file (the file-path-RDD partitioning of
+    ImageLoaderUtils.scala:22). Defaults to the jax process grid."""
+    if os.path.isdir(location):
+        paths = sorted(
+            os.path.join(location, f)
+            for f in os.listdir(location)
+            if f.endswith(".tar")
+        )
+    else:
+        paths = [location]
+    if shard_index is None or num_shards is None:
+        import jax
+
+        shard_index = jax.process_index()
+        num_shards = jax.process_count()
+    return paths[shard_index::num_shards]
+
+
+def imagenet_label_fn(labels_path: str) -> Callable[[str], Optional[int]]:
+    """Member name -> class via the WNID map file ("n15075141 12" lines,
+    ImageNetLoader.scala label map)."""
+    label_map: Dict[str, int] = {}
+    with open(labels_path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) >= 2:
+                label_map[parts[0]] = int(parts[1])
+
+    def fn(name: str) -> Optional[int]:
+        wnid = name.split("/")[0].split("_")[0]
+        return label_map.get(wnid)
+
+    return fn
+
+
+def voc_label_fn(labels_path: str) -> Callable[[str], Optional[List[int]]]:
+    """Member name -> multi-label class list via voclabels.csv
+    (VOCLoader.scala:15)."""
+    by_file: Dict[str, List[int]] = {}
+    with open(labels_path) as f:
+        for row in csv.DictReader(f):
+            fname = row["filename"].split("/")[-1]
+            by_file.setdefault(fname, []).append(int(row["class"]) - 1)
+
+    def fn(name: str) -> Optional[List[int]]:
+        return by_file.get(name.split("/")[-1])
+
+    return fn
+
+
+class StreamingImageLoader:
+    """Bounded-memory tar → batch pipeline (see module docstring).
+
+    Args:
+      paths: tar files THIS process reads (use ``tar_shard_paths`` for
+        the multi-host round-robin assignment).
+      label_fn: member name -> label (int, list, or any object); None
+        skips the member (reference: unmapped WNIDs are dropped).
+      decode_size: if set, every image is decoded+resized to
+        (decode_size, decode_size, 3) so batches are fixed-shape arrays;
+        None keeps native sizes (``items()`` iteration only).
+      cycle: read the tar list this many times (bench mode: a small
+        fixture tar cycled to ImageNet-scale image counts).
+      decode_threads / decode_window: decode pool size and the bound on
+        in-flight images (the RSS bound).
+    """
+
+    def __init__(
+        self,
+        paths: Sequence[str],
+        label_fn: Callable[[str], Optional[object]],
+        decode_size: Optional[int] = None,
+        cycle: int = 1,
+        decode_threads: int = 8,
+        decode_window: int = 64,
+        limit: Optional[int] = None,
+    ):
+        self.paths = list(paths)
+        self.label_fn = label_fn
+        self.decode_size = decode_size
+        self.cycle = cycle
+        self.decode_threads = decode_threads
+        self.decode_window = decode_window
+        self.limit = limit
+
+    # -- raw member stream -------------------------------------------------
+
+    def _iter_raw(self) -> Iterator[Tuple[str, object, bytes]]:
+        """(name, label, jpeg bytes) for labeled members, streamed one
+        tar member at a time (tarfile reads sequentially; nothing is
+        extracted to disk or held beyond the current member)."""
+        emitted = 0
+        for _ in range(self.cycle):
+            for path in self.paths:
+                with tarfile.open(path) as tf:
+                    for member in tf:
+                        if not member.isfile():
+                            continue
+                        label = self.label_fn(member.name)
+                        if label is None:
+                            continue
+                        f = tf.extractfile(member)
+                        if f is None:
+                            continue
+                        yield member.name, label, f.read()
+                        emitted += 1
+                        if self.limit is not None and emitted >= self.limit:
+                            return
+
+    # -- decode ------------------------------------------------------------
+
+    def _decode(self, data: bytes) -> Optional[np.ndarray]:
+        from PIL import Image as PILImage
+
+        try:
+            img = PILImage.open(io.BytesIO(data))
+            if self.decode_size is not None:
+                # draft: decode the JPEG DCT at the coarsest scale still
+                # >= target — the decode-speed lever at ImageNet scale
+                img.draft("RGB", (self.decode_size, self.decode_size))
+            img = img.convert("RGB")
+            if self.decode_size is not None:
+                img = img.resize(
+                    (self.decode_size, self.decode_size),
+                    PILImage.BILINEAR,
+                )
+            return np.asarray(img, dtype=np.float32)
+        except Exception:
+            return None
+
+    def items(self) -> Iterator[Tuple[str, object, np.ndarray]]:
+        """Order-preserving decoded stream with a bounded window of
+        decode futures in flight (the eager loaders' list materialized
+        one element at a time)."""
+        with ThreadPoolExecutor(self.decode_threads) as ex:
+            pending: deque = deque()
+            for name, label, data in self._iter_raw():
+                pending.append((name, label, ex.submit(self._decode, data)))
+                if len(pending) >= self.decode_window:
+                    n, l, fut = pending.popleft()
+                    arr = fut.result()
+                    if arr is not None:
+                        yield n, l, arr
+            while pending:
+                n, l, fut = pending.popleft()
+                arr = fut.result()
+                if arr is not None:
+                    yield n, l, arr
+
+    # -- fixed-shape batches ----------------------------------------------
+
+    def batches(
+        self, batch_size: int
+    ) -> Iterator[Tuple[np.ndarray, List[object], int]]:
+        """(images (B, s, s, 3) float32, labels, n_valid) batches; the
+        final batch is zero-padded past n_valid. Requires decode_size."""
+        if self.decode_size is None:
+            raise ValueError("batches() requires decode_size")
+        s = self.decode_size
+        buf = np.zeros((batch_size, s, s, 3), np.float32)
+        labels: List[object] = []
+        fill = 0
+        for _, label, arr in self.items():
+            buf[fill] = arr
+            labels.append(label)
+            fill += 1
+            if fill == batch_size:
+                yield buf, labels, fill
+                buf = np.zeros((batch_size, s, s, 3), np.float32)
+                labels = []
+                fill = 0
+        if fill:
+            yield buf, labels, fill
+
+
+def StreamingImageNetLoader(
+    location: str,
+    labels_path: str,
+    decode_size: Optional[int] = None,
+    shard_index: Optional[int] = None,
+    num_shards: Optional[int] = None,
+    **kw,
+) -> StreamingImageLoader:
+    """Sharded streaming ImageNet reader (ImageNetLoader.scala:11 over
+    the streaming substrate)."""
+    return StreamingImageLoader(
+        tar_shard_paths(location, shard_index, num_shards),
+        imagenet_label_fn(labels_path),
+        decode_size=decode_size,
+        **kw,
+    )
+
+
+def StreamingVOCLoader(
+    location: str,
+    labels_path: str,
+    decode_size: Optional[int] = None,
+    shard_index: Optional[int] = None,
+    num_shards: Optional[int] = None,
+    **kw,
+) -> StreamingImageLoader:
+    """Sharded streaming VOC2007 reader (VOCLoader.scala:15 over the
+    streaming substrate)."""
+    return StreamingImageLoader(
+        tar_shard_paths(location, shard_index, num_shards),
+        voc_label_fn(labels_path),
+        decode_size=decode_size,
+        **kw,
+    )

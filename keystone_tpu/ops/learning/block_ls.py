@@ -60,9 +60,9 @@ def _f32_mm(a, b):
     )
 
 
-def _psd_solve_device(gram, rhs, lam):
-    """(gram + lam·I) X = rhs on device, f32 Cholesky + two iterative-
-    refinement steps. Refinement recovers most of the f64 accuracy the
+def _psd_solve_device(gram, rhs, lam, refine=2):
+    """(gram + lam·I) X = rhs on device, f32 Cholesky + ``refine``
+    iterative-refinement steps. Refinement recovers most of the f64 accuracy the
     reference's driver-side LAPACK solve had (mlmatrix NormalEquations;
     BlockLinearMapper.scala:234-240) without a host round-trip — through
     a remote-dispatch link every host sync costs ~100 ms, so the solve
@@ -82,7 +82,7 @@ def _psd_solve_device(gram, rhs, lam):
             return jax.scipy.linalg.cho_solve((L, True), b)
 
         W = solve(rhs)
-        for _ in range(2):
+        for _ in range(refine):
             W = W + solve(rhs - jnp.matmul(A, W, precision=hp))
         return W
 

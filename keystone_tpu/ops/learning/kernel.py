@@ -41,17 +41,32 @@ from keystone_tpu.utils.checkpoint import (
 from keystone_tpu.workflow.api import Estimator, LabelEstimator, Transformer
 
 
-@partial(jax.jit, static_argnames=("width",))
-def _rbf_block(X, X_norms, gamma, mask, start, *, width):
+def _cross_mm_x3(A, B):
+    """A·Bᵀ for f32 operands with XLA's 3-pass bf16 algorithm — ~2×
+    faster than the 6-pass HIGHEST decomposition at ~1.5e-5 relative
+    error, which the RBF distance tolerates: the kernel's sensitivity is
+    γ·|d² error| and γ·1.5e-5·‖x‖² ≪ any solver tolerance here."""
+    return jax.lax.dot_general(
+        A, B, (((1,), (1,)), ((), ())),
+        precision=jax.lax.DotAlgorithmPreset.BF16_BF16_F32_X3,
+    )
+
+
+def _rbf_block_body(X, X_norms, gamma, mask, start, width):
     """K(:, B) for a contiguous train block: exp(−γ(‖x‖²+‖x_B‖²−2x·x_B)).
     Pad rows AND pad columns are zeroed — exp(·) of a zero pad vector is
     nonzero and would pollute the Gauss-Seidel solves."""
     Xb = jax.lax.dynamic_slice_in_dim(X, start, width, axis=0)
     nb = jax.lax.dynamic_slice_in_dim(X_norms, start, width, axis=0)
     mask_b = jax.lax.dynamic_slice_in_dim(mask, start, width, axis=0)
-    d2 = X_norms[:, None] + nb[None, :] - 2.0 * _f32_mm(X, Xb.T)
+    d2 = X_norms[:, None] + nb[None, :] - 2.0 * _cross_mm_x3(X, Xb)
     K = jnp.exp(-gamma * jnp.maximum(d2, 0.0))
     return K * mask[:, None] * mask_b[None, :]
+
+
+@partial(jax.jit, static_argnames=("width",))
+def _rbf_block(X, X_norms, gamma, mask, start, *, width):
+    return _rbf_block_body(X, X_norms, gamma, mask, start, width)
 
 
 @dataclasses.dataclass(eq=False)
@@ -107,7 +122,7 @@ def _rbf_cross_block(Xt, Xt_norms, train_X, train_norms, gamma, mask_t,
     Xb = jax.lax.dynamic_slice_in_dim(train_X, start, width, axis=0)
     nb = jax.lax.dynamic_slice_in_dim(train_norms, start, width, axis=0)
     mask_b = jax.lax.dynamic_slice_in_dim(train_mask, start, width, axis=0)
-    d2 = Xt_norms[:, None] + nb[None, :] - 2.0 * _f32_mm(Xt, Xb.T)
+    d2 = Xt_norms[:, None] + nb[None, :] - 2.0 * _cross_mm_x3(Xt, Xb)
     K = jnp.exp(-gamma * jnp.maximum(d2, 0.0))
     return K * mask_t[:, None] * mask_b[None, :]
 
@@ -180,24 +195,52 @@ def _krr_update_model(W, Wb_new, start, *, width):
     return jax.lax.dynamic_update_slice_in_dim(W, Wb_new, start, axis=0)
 
 
-@partial(jax.jit, static_argnames=("width",), donate_argnums=(4,))
-def _krr_block_step(X, X_norms, gamma, mask, W, Y, start, lam, *, width):
+def _krr_block_body(X, X_norms, gamma, mask, W, Y, start, lam, width):
     """One whole Gauss-Seidel block update as a single device program:
     materialize K(:, B), form the residual rhs, solve (K_BB + λI) on
     device (f32 Cholesky + refinement, block_ls._psd_solve_device), and
     scatter the block model — the reference's materialize → treeReduce →
     driver-solve → broadcast round trip (KernelRidgeRegression.scala:
     86-235) with zero host synchronization."""
-    K_block = _rbf_block.__wrapped__(
-        X, X_norms, gamma, mask, start, width=width
+    K_block = _rbf_block_body(X, X_norms, gamma, mask, start, width)
+    # contract the example axis without a .T relayout of the n×b block
+    resid = jax.lax.dot_general(
+        K_block, W, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )
-    resid = _f32_mm(K_block.T, W)
     K_bb = jax.lax.dynamic_slice_in_dim(K_block, start, width, axis=0)
     Wb_old = jax.lax.dynamic_slice_in_dim(W, start, width, axis=0)
     y_b = jax.lax.dynamic_slice_in_dim(Y, start, width, axis=0)
     rhs = y_b - (resid - _f32_mm(K_bb.T, Wb_old))
-    Wb_new = _psd_solve_device(K_bb, rhs, lam)
+    # one refinement step: each extra step is a triangular-solve pair
+    # (~3 ms at b=4096), and Gauss-Seidel tolerates per-block solves at
+    # f32+1-refine accuracy (validated against the host-f64 path by
+    # tests/ops/test_kernel.py)
+    Wb_new = _psd_solve_device(K_bb, rhs, lam, refine=1)
     return jax.lax.dynamic_update_slice_in_dim(W, Wb_new, start, axis=0)
+
+
+@partial(jax.jit, static_argnames=("width",), donate_argnums=(4,))
+def _krr_block_step(X, X_norms, gamma, mask, W, Y, start, lam, *, width):
+    return _krr_block_body(X, X_norms, gamma, mask, W, Y, start, lam,
+                           width)
+
+
+@partial(jax.jit, static_argnames=("width",), donate_argnums=(4,))
+def _krr_epoch_scan(X, X_norms, gamma, mask, W, Y, starts, lam, *, width):
+    """A whole epoch (or several) of Gauss-Seidel block updates as ONE
+    scanned device program — per-block dispatches each cost ~15-30 ms of
+    queue latency through a remote tunnel, which at 12 blocks dominated
+    the r3 krr_block_solve row (PROFILE_r04)."""
+
+    def step(W, start):
+        return _krr_block_body(
+            X, X_norms, gamma, mask, W, Y, start, lam, width
+        ), None
+
+    W, _ = jax.lax.scan(step, W, starts)
+    return W
 
 
 @dataclasses.dataclass(eq=False)
@@ -305,6 +348,29 @@ class KernelRidgeRegression(LabelEstimator):
                 W = jnp.asarray(state["W"], jnp.float32)
                 start_epoch = int(state["epoch"])
                 start_pos = int(state["pos"])
+
+        if (
+            self.solve == "device"
+            and ckpt is None
+            and self.block_callback is None
+            and len({wd for _, wd in blocks}) == 1
+        ):
+            # fast path: every epoch's whole block schedule as one
+            # scanned program, one dispatch for the entire fit
+            all_starts = [
+                blocks[i][0]
+                for epoch in range(self.num_epochs)
+                for i in self._epoch_order(epoch, len(blocks))
+            ]
+            W = _krr_epoch_scan(
+                transformer.train_X, transformer._norms,
+                transformer.gamma, transformer.train_mask,
+                W, Y, jnp.asarray(all_starts, jnp.int32), self.lam,
+                width=blocks[0][1],
+            )
+            return KernelBlockLinearMapper(
+                W, self.block_size, transformer, n
+            )
 
         done = 0
         order, order_epoch = [], -1

