@@ -545,6 +545,20 @@ class FittedPipeline:
         """The single-example apply path as one jitted function."""
         return jax.jit(lambda x: self._run(x, batch=False))
 
+    def jit_batch(self) -> Callable[[Any], Any]:
+        """The WHOLE batched apply path as ONE compiled XLA program —
+        the SURVEY §7 lowering: array in, array out, every node's
+        batch_transform traced into a single staged computation (XLA
+        fuses across node boundaries; no per-node dispatch). Requires an
+        array-mode transformer chain (host-side items-mode nodes, e.g.
+        string tokenizers, cannot trace — use ``apply`` for those)."""
+
+        def run(arr):
+            out = self._run(Dataset.from_array(arr), batch=True)
+            return out.padded() if isinstance(out, Dataset) else out
+
+        return jax.jit(run)
+
     def and_then(self, nxt: "FittedPipeline") -> "FittedPipeline":
         g, _, sink_map = self.graph.connect_graph(
             nxt.graph, {nxt.source: self.sink}

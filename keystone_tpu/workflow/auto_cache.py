@@ -246,9 +246,34 @@ class AutoCacheRule(Rule):
     def aggressive_cache(
         self, graph: Graph, weights: Dict[NodeId, int]
     ) -> Set[NodeId]:
-        """Cache every node evaluated more than once (reference :503)."""
-        runs = get_runs(graph, set(), weights)
-        return {n for n, r in runs.items() if r > 1}
+        """Cache every node whose DIRECT output is consumed more than
+        once — Σ over direct children of the child's weight (sinks count
+        1) — excluding descendants of sources (test-time data; reference
+        AutoCacheRule.aggressiveCache:503-518). NOT the transitive run
+        count: a node feeding a single hot consumer is NOT cached (its
+        consumer is), matching the reference suite's {+2, +5} selection
+        on its 13-node plan."""
+        from keystone_tpu.workflow.graph import get_descendants
+
+        source_desc: Set[NodeId] = set()
+        for src in graph.sources:
+            source_desc |= {
+                d for d in get_descendants(graph, src)
+                if isinstance(d, NodeId)
+            }
+        selected: Set[NodeId] = set()
+        for n in graph.operators:
+            if n in source_desc:
+                continue
+            total = 0
+            for c in get_children(graph, n):
+                if isinstance(c, NodeId):
+                    total += weights.get(c, 1)
+                else:
+                    total += 1
+            if total > 1:
+                selected.add(n)
+        return selected
 
     def greedy_cache(
         self,
@@ -265,8 +290,15 @@ class AutoCacheRule(Rule):
         while True:
             base = estimate_cached_runtime(graph, cached, profiles, weights)
             best, best_rt = None, base
+            runs = get_runs(graph, cached, weights)
             for n, p in profiles.items():
-                if n in cached or p.device_mem + used > budget:
+                # reference selectNext:542 — only nodes still evaluated
+                # more than once and fitting the remaining budget
+                if (
+                    n in cached
+                    or runs.get(n, 1) <= 1
+                    or p.device_mem + used > budget
+                ):
                     continue
                 rt = estimate_cached_runtime(
                     graph, cached | {n}, profiles, weights

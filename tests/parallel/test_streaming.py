@@ -266,3 +266,42 @@ def test_streaming_rss_stays_flat(tar_dir):
         f"RSS grew {growth:.0f} MB while streaming 4000 images "
         f"(eager load would be ~440 MB) — pipeline is materializing"
     )
+
+
+def test_voc_stream_matches_eager_loader(tmp_path):
+    """VOC multi-label path: the streaming reader and the eager
+    VOCLoader must label the same members identically (the ImageNet
+    parity test alone left the VOC csv path uncovered)."""
+    from keystone_tpu.loaders.image_loaders import VOCLoader
+    from keystone_tpu.loaders.streaming import StreamingVOCLoader
+
+    d = tmp_path / "voc"
+    d.mkdir()
+    make_image_tar(str(d / "voc_imgs.tar"), "img", 6, seed0=7)
+    labels = tmp_path / "voclabels.csv"
+    rows = ["id,class,classname,traintesteval,filename"]
+    # images 0..4 labeled (img_2 multi-label); img_5 unlabeled -> dropped
+    rows += [
+        "1,1,aeroplane,train,VOC2007/img_0.JPEG",
+        "2,2,bicycle,train,VOC2007/img_1.JPEG",
+        "3,1,aeroplane,train,VOC2007/img_2.JPEG",
+        "4,3,bird,train,VOC2007/img_2.JPEG",
+        "5,2,bicycle,train,VOC2007/img_3.JPEG",
+        "6,1,aeroplane,train,VOC2007/img_4.JPEG",
+    ]
+    labels.write_text("\n".join(rows) + "\n")
+
+    eager = VOCLoader(str(d), str(labels)).items()
+    stream = list(
+        StreamingVOCLoader(
+            str(d), str(labels), shard_index=0, num_shards=1
+        ).items()
+    )
+    assert len(stream) == len(eager) == 5
+    for (name, labs, arr), item in zip(stream, eager):
+        assert name.split("/")[-1] == item.filename
+        assert labs == item.labels
+        np.testing.assert_allclose(arr, item.image)
+    # the multi-label member carries both classes (0-indexed)
+    multi = [l for n, l, _ in stream if "img_2" in n]
+    assert multi == [[0, 2]]

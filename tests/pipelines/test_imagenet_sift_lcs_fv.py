@@ -109,3 +109,64 @@ def test_flagship_branch_feature_dims(mesh8):
     branch = compute_pca_and_fisher_branch(prefix, images, conf, None, None)
     feats = np.asarray(branch(images).get().array())
     assert feats.shape == (images.n, 2 * conf.desc_dim * conf.vocab_size)
+
+
+def test_flagship_featurize_jit_batch_matches_executor():
+    """FittedPipeline.jit_batch lowers the WHOLE SIFT+LCS -> PCA -> FV
+    featurize graph (gather join, bucket-vmapped extractors, Hellinger/
+    L2 chain) into one compiled program; it must match the node-by-node
+    graph-executor path."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.images.core import GrayScaler, PixelScaler
+    from keystone_tpu.ops.images.fisher_vector import FisherVector
+    from keystone_tpu.ops.images.lcs import LCSExtractor
+    from keystone_tpu.ops.images.sift import SIFTExtractor
+    from keystone_tpu.ops.learning import BatchPCATransformer
+    from keystone_tpu.ops.learning.gmm import GaussianMixtureModel
+    from keystone_tpu.ops.stats import NormalizeRows, SignedHellingerMapper
+    from keystone_tpu.ops.util.nodes import (
+        FloatToDouble, MatrixVectorizer, VectorCombiner,
+    )
+    from keystone_tpu.workflow.api import Pipeline
+
+    rng = np.random.default_rng(0)
+    desc_dim, vocab = 8, 4
+
+    def branch(prefix, in_dim):
+        pca = jnp.asarray(
+            rng.standard_normal((desc_dim, in_dim)).astype(np.float32) * 0.1
+        )
+        gmm = GaussianMixtureModel(
+            jnp.asarray(rng.standard_normal((desc_dim, vocab)), jnp.float32),
+            jnp.ones((desc_dim, vocab), jnp.float32),
+            jnp.ones((vocab,), jnp.float32) / vocab,
+        )
+        return (
+            prefix
+            .and_then(BatchPCATransformer(pca.T))
+            .and_then(FisherVector(gmm))
+            .and_then(FloatToDouble())
+            .and_then(MatrixVectorizer())
+            .and_then(NormalizeRows())
+            .and_then(SignedHellingerMapper())
+            .and_then(NormalizeRows())
+        )
+
+    sift = branch(
+        PixelScaler().and_then(GrayScaler())
+        .and_then(SIFTExtractor(step=8, bin=4, num_scales=1))
+        .and_then(SignedHellingerMapper()),
+        128,
+    )
+    lcs = branch(LCSExtractor(8, 16, 4).to_pipeline(), 96)
+    pipe = Pipeline.gather([sift, lcs]).and_then(VectorCombiner())
+
+    imgs = jnp.asarray(
+        rng.integers(0, 255, (4, 48, 48, 3)).astype(np.float32)
+    )
+    ref = pipe.apply(Dataset.from_array(imgs)).get().padded()
+    out = pipe.fit().jit_batch()(imgs)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=1e-5
+    )

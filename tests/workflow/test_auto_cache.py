@@ -101,3 +101,123 @@ def test_profile_nodes_produces_estimates():
     profiles = profile_nodes(g, sorted(g.operators))
     assert ids["a"] in profiles
     assert profiles[ids["a"]].ns >= 0
+
+
+# -- the reference suite's 13-node plan + profile staircase -------------
+# (AutocCacheRuleSuite.scala:27-73: train branch 0->1->2->(3,4)->5->
+# estimator(weight 4)->delegating; test branch 8..12 downstream of the
+# source; greedy selections must follow the exact budget staircase)
+
+
+class _Plus(TransformerOperator):
+    def __init__(self, plus, weight=1):
+        self.plus = plus
+        self.weight = weight
+
+    def single_transform(self, inputs):
+        return inputs[0] + self.plus
+
+    def batch_transform(self, inputs):
+        return inputs[0].map_arrays(lambda a: a + self.plus)
+
+    def eq_key(self):
+        return ("plus", self.plus)
+
+    def __repr__(self):
+        return f"Plus({self.plus})"
+
+
+class _WeightedEstimatorOp(TransformerOperator):
+    """Stands in for the reference's weight-4 estimator node (only the
+    weight matters to the cache rule)."""
+
+    weight = 4
+
+    def single_transform(self, inputs):
+        return inputs[0]
+
+    def batch_transform(self, inputs):
+        return inputs[0]
+
+    def eq_key(self):
+        return id(self)
+
+
+def _reference_plan():
+    from keystone_tpu.workflow.graph import Graph, SinkId, SourceId
+    from keystone_tpu.workflow.operators import DelegatingOperator
+
+    ds = Dataset.of(np.arange(8, dtype=np.float32)[:, None])
+    nid = {i: NodeId(i) for i in range(13)}
+    g = Graph(
+        sources=frozenset({SourceId(0)}),
+        sink_dependencies={SinkId(0): nid[7]},
+        operators={
+            nid[0]: DatasetOperator(ds),
+            nid[1]: _Plus(1),
+            nid[2]: _Plus(2),
+            nid[3]: _Plus(3),
+            nid[4]: _Plus(4),
+            nid[5]: _Plus(5),
+            nid[6]: _WeightedEstimatorOp(),
+            nid[7]: DelegatingOperator(),
+            nid[8]: _Plus(8),
+            nid[9]: _Plus(9),
+            nid[10]: _Plus(10),
+            nid[11]: _Plus(11),
+            nid[12]: _Plus(12),
+        },
+        dependencies={
+            nid[0]: (),
+            nid[1]: (nid[0],),
+            nid[2]: (nid[1],),
+            nid[3]: (nid[2],),
+            nid[4]: (nid[2],),
+            nid[5]: (nid[3], nid[4]),
+            nid[6]: (nid[5],),
+            nid[7]: (nid[6], nid[12]),
+            nid[8]: (SourceId(0),),
+            nid[9]: (nid[8],),
+            nid[10]: (nid[9],),
+            nid[11]: (nid[9],),
+            nid[12]: (nid[10], nid[11]),
+        },
+    )
+    profiles = {
+        nid[0]: Profile(10, float("inf"), 0),
+        nid[1]: Profile(10, 50, 0),
+        nid[2]: Profile(30, 200, 0),
+        nid[3]: Profile(20, 1000, 0),
+        nid[4]: Profile(20, 1000, 0),
+        nid[5]: Profile(20, 100, 0),
+    }
+    return g, nid, profiles
+
+
+def test_reference_plan_aggressive_selection():
+    """Aggressive = direct-consumer weight sum > 1, source descendants
+    excluded (AutocCacheRuleSuite 'Aggressive cacher': {+2, +5} — NOT
+    the transitively-hot nodes 3/4, and NOT the twice-consumed test-
+    branch node 9)."""
+    g, nid, _ = _reference_plan()
+    rule = AutoCacheRule("aggressive")
+    assert rule.aggressive_cache(g, get_node_weights(g)) == {
+        nid[2], nid[5]
+    }
+
+
+@pytest.mark.parametrize("budget,expected", [
+    (10, set()),
+    (75, {1}),
+    (125, {5}),
+    (175, {1, 5}),
+    (350, {2, 5}),
+    (10000, {2, 5}),
+])
+def test_reference_plan_greedy_staircase(budget, expected):
+    """The six greedy budget selections of AutocCacheRuleSuite.scala:
+    111-193, ported verbatim."""
+    g, nid, profiles = _reference_plan()
+    rule = AutoCacheRule("greedy", mem_budget_bytes=budget)
+    got = rule.greedy_cache(g, profiles, get_node_weights(g))
+    assert got == {nid[i] for i in expected}, (budget, got)

@@ -220,3 +220,30 @@ def test_incremental_second_estimator_fits_on_first_output():
     np.testing.assert_allclose(out, [-2.0])  # 1 - mean(3) + 0
     assert est1.fit_count == 1
     assert est2.fit_count == 1
+
+
+def test_fitted_pipeline_jit_batch_matches_executor():
+    """jit_batch lowers the WHOLE fitted transformer graph into one
+    compiled program (SURVEY §7 staging); it must match the node-by-node
+    executor path on an array-mode chain, including a gather join."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.stats import (
+        LinearRectifier, NormalizeRows, RandomSignNode,
+    )
+    from keystone_tpu.ops.util.nodes import VectorCombiner
+    from keystone_tpu.workflow.api import Pipeline
+
+    branches = [
+        RandomSignNode.create(12, seed=i)
+        .and_then(LinearRectifier(0.0))
+        .and_then(NormalizeRows())
+        for i in range(2)
+    ]
+    pipe = Pipeline.gather(branches).and_then(VectorCombiner())
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((6, 12)).astype(np.float32)
+    )
+    ref = pipe.apply(Dataset.from_array(x)).get().padded()
+    out = pipe.fit().jit_batch()(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
