@@ -17,11 +17,28 @@ A from-scratch re-design of the capabilities of KeystoneML
 
 __version__ = "0.1.0"
 
-from keystone_tpu.workflow import (  # noqa: F401
-    Estimator,
-    FunctionNode,
-    LabelEstimator,
-    Pipeline,
-    Transformer,
-)
-from keystone_tpu.parallel.dataset import Dataset  # noqa: F401
+# LAZY re-exports (PEP 562): the eager form imported jax at package
+# import, which made ANY submodule import pay the multi-second jax
+# startup — including the streaming loader's spawn decode workers,
+# which must stay jax-free (loaders/streaming.py). Attribute access
+# still works exactly as before: ``from keystone_tpu import Pipeline``.
+_EXPORTS = {
+    "Estimator": "keystone_tpu.workflow",
+    "FunctionNode": "keystone_tpu.workflow",
+    "LabelEstimator": "keystone_tpu.workflow",
+    "Pipeline": "keystone_tpu.workflow",
+    "Transformer": "keystone_tpu.workflow",
+    "Dataset": "keystone_tpu.parallel.dataset",
+}
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module(_EXPORTS[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_EXPORTS))

@@ -1,3 +1,17 @@
-from keystone_tpu.loaders.csv_loader import CsvDataLoader, LabeledData
+# LAZY re-exports (PEP 562) — see keystone_tpu/__init__.py: the
+# streaming loader's spawn decode workers import this package and must
+# not pull in jax (csv_loader -> parallel.dataset -> jax).
+_EXPORTS = {
+    "CsvDataLoader": "keystone_tpu.loaders.csv_loader",
+    "LabeledData": "keystone_tpu.loaders.csv_loader",
+}
 
-__all__ = ["CsvDataLoader", "LabeledData"]
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module(_EXPORTS[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -305,3 +305,24 @@ def test_voc_stream_matches_eager_loader(tmp_path):
     # the multi-label member carries both classes (0-indexed)
     multi = [l for n, l, _ in stream if "img_2" in n]
     assert multi == [[0, 2]]
+
+
+def test_process_pool_decode_matches_threads(tar_dir):
+    """decode_processes > 0 (spawn workers, GIL-free) must yield the
+    exact same ordered stream as the thread path."""
+    loc, labels = tar_dir
+    thread = list(
+        StreamingImageNetLoader(
+            loc, labels, decode_size=32, shard_index=0, num_shards=1
+        ).items()
+    )
+    proc = list(
+        StreamingImageNetLoader(
+            loc, labels, decode_size=32, shard_index=0, num_shards=1,
+            decode_processes=2, decode_window=8,
+        ).items()
+    )
+    assert len(proc) == len(thread) == 20
+    for (n1, l1, a1), (n2, l2, a2) in zip(proc, thread):
+        assert n1 == n2 and l1 == l2
+        np.testing.assert_array_equal(a1, a2)
