@@ -38,7 +38,7 @@ import multiprocessing
 import os
 import tarfile
 from collections import deque
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -205,32 +205,27 @@ class StreamingImageLoader:
                         if self.limit is not None and emitted >= self.limit:
                             return
 
-    # -- decode ------------------------------------------------------------
-
-    def _decode(self, data: bytes) -> Optional[np.ndarray]:
-        return _decode_payload((data, self.decode_size))
-
     def items(self) -> Iterator[Tuple[str, object, np.ndarray]]:
         """Order-preserving decoded stream with a bounded window of
         decode futures in flight (the eager loaders' list materialized
         one element at a time)."""
+        # both pools run the same module-level _decode_payload through
+        # the concurrent.futures API: ProcessPoolExecutor (vs
+        # multiprocessing.Pool) raises BrokenProcessPool if a spawn
+        # worker is OOM-killed or segfaults mid-decode instead of
+        # hanging the in-flight .get() forever
         if self.decode_processes > 0:
-            # spawn pool: GIL-free decode. ``Pool.imap`` is NOT used
-            # because its feeder thread drains the input iterator
-            # unboundedly; apply_async + the shared window keeps the
-            # RSS bound.
-            ctx = multiprocessing.get_context("spawn")
-            with ctx.Pool(self.decode_processes) as pool:
-                yield from self._bounded_ordered_decode(
-                    lambda data: pool.apply_async(
-                        _decode_payload, ((data, self.decode_size),)
-                    ),
-                    lambda res: res.get(),
-                )
-            return
-        with ThreadPoolExecutor(self.decode_threads) as ex:
+            ex = ProcessPoolExecutor(
+                self.decode_processes,
+                mp_context=multiprocessing.get_context("spawn"),
+            )
+        else:
+            ex = ThreadPoolExecutor(self.decode_threads)
+        with ex:
             yield from self._bounded_ordered_decode(
-                lambda data: ex.submit(self._decode, data),
+                lambda data: ex.submit(
+                    _decode_payload, (data, self.decode_size)
+                ),
                 lambda fut: fut.result(),
             )
 
