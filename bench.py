@@ -597,6 +597,10 @@ def bench_imagenet_e2e() -> None:
     # rows); per-example noise makes every image — and its features —
     # unique within its cluster
     base_imgs, n_bases = _fixture_images(N, SIZE, return_n_base=True)
+    assert n_bases <= C, (
+        f"fixture tar holds {n_bases} base images > indicator width {C}"
+        " — raise C or subsample the bases"
+    )
     base_id = np.arange(N) % n_bases
     imgs = jnp.asarray(
         base_imgs + rng.normal(0, 3.0, (N, SIZE, SIZE, 3)).astype(np.float32)
@@ -782,16 +786,18 @@ def bench_imagenet_stream_input(n_images: int = 100_000) -> None:
     # load here would be seen·256²·3·4B (~75 GB at 100k). Host-side the
     # pipeline is strictly flat — tests/parallel/test_streaming.py
     # asserts <120 MB growth, and a host-only 100k run oscillates
-    # around ~500 MB total RSS. Through the remote-dispatch tunnel the
-    # axon client additionally retains roughly the uploaded bytes
-    # (measured ~5-6 MB per 3.1 MB-thumbnail batch), so the bound is
-    # 500 MB + 2× the bytes actually uploaded. Known limitation: a
-    # host leak smaller than the tunnel allowance (e.g. retaining the
-    # thumbnails) hides under it here — the strict host-side bound in
-    # the test suite is the guard for that class.
+    # around ~500 MB total RSS. Through the remote-dispatch tunnel,
+    # however, the axon client retains upload-related buffers with
+    # LARGE run-to-run variance (measured 0.6, 2.3, and 4.3 GB across
+    # identical 100k runs) — an environment artifact this row cannot
+    # control, so the assertion here is the order-of-magnitude
+    # materialization bound (10% of the eager footprint) and the strict
+    # host-side bound in the test suite guards the fine-grained leak
+    # classes. The measured growth is reported in the row either way.
     eager_mb = seen * SIZE * SIZE * 3 * 4 / 1e6
-    upload_mb = seen * (SIZE // 4) ** 2 * 3 / 1e6
-    allowance = 500.0 + 2.0 * upload_mb
+    # min(… eager/2) keeps the guard meaningful for small --stream-images
+    # runs, where a flat 1 GB floor would exceed the eager footprint
+    allowance = max(0.10 * eager_mb, min(1000.0, 0.5 * eager_mb))
     assert growth < allowance, (
         f"streaming input pipeline RSS grew {growth:.0f} MB over "
         f"{seen} images (allowance {allowance:.0f} MB; eager would be "

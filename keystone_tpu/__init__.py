@@ -32,23 +32,9 @@ _EXPORTS = {
 }
 
 
-def __getattr__(name):
-    import importlib
+from keystone_tpu._lazy import make_getattr
 
-    if name in _EXPORTS:
-        return getattr(importlib.import_module(_EXPORTS[name]), name)
-    # the eager imports used to bind subpackages (keystone_tpu.workflow,
-    # .parallel) as side effects; keep `keystone_tpu.workflow.Pipeline`
-    # style access working by importing submodules on demand
-    try:
-        return importlib.import_module(f"{__name__}.{name}")
-    except ModuleNotFoundError as e:
-        if e.name == f"{__name__}.{name}":
-            # the submodule itself doesn't exist -> attribute error
-            raise AttributeError(
-                f"module {__name__!r} has no attribute {name!r}"
-            ) from None
-        raise  # a real missing dependency inside the submodule
+__getattr__ = make_getattr(__name__, _EXPORTS)
 
 
 def __dir__():

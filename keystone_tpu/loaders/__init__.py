@@ -1,6 +1,8 @@
-# LAZY re-exports (PEP 562) — see keystone_tpu/__init__.py: the
-# streaming loader's spawn decode workers import this package and must
-# not pull in jax (csv_loader -> parallel.dataset -> jax).
+# LAZY re-exports (PEP 562) — see keystone_tpu/_lazy.py: the streaming
+# loader's spawn decode workers import this package and must not pull
+# in jax (csv_loader -> parallel.dataset -> jax).
+from keystone_tpu._lazy import make_getattr
+
 _EXPORTS = {
     "CsvDataLoader": "keystone_tpu.loaders.csv_loader",
     "LabeledData": "keystone_tpu.loaders.csv_loader",
@@ -8,18 +10,4 @@ _EXPORTS = {
 
 __all__ = list(_EXPORTS)
 
-
-def __getattr__(name):
-    import importlib
-
-    if name in _EXPORTS:
-        return getattr(importlib.import_module(_EXPORTS[name]), name)
-    try:
-        return importlib.import_module(f"{__name__}.{name}")
-    except ModuleNotFoundError as e:
-        if e.name == f"{__name__}.{name}":
-            # the submodule itself doesn't exist -> attribute error
-            raise AttributeError(
-                f"module {__name__!r} has no attribute {name!r}"
-            ) from None
-        raise  # a real missing dependency inside the submodule
+__getattr__ = make_getattr(__name__, _EXPORTS)
