@@ -13,38 +13,80 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 from typing import Optional, Tuple
 
 import numpy as np
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libkeystone_io.so")
+_JPEG_LIB_PATH = os.path.join(_NATIVE_DIR, "libkeystone_jpeg.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+_jpeg_lib: Optional[ctypes.CDLL] = None
+_jpeg_tried = False
+# first use commonly happens from inside the streaming loader's decode
+# THREAD pool — without the lock, threads arriving while another is
+# mid-load see tried=True/lib=None and silently take the slow fallback
+# for the whole stream. RLock: the jpeg loader calls _load() while
+# holding it (one shared build attempt covers both libraries).
+_load_lock = threading.RLock()
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _tried
+    # the unlocked fast path must only trust _tried AFTER a load attempt
+    # fully completed — _load_locked flips it as its last action, never
+    # before, or waiting threads would see tried=True/lib=None mid-load
+    # and silently take the slow fallback for the whole stream
     if _lib is not None or _tried:
         return _lib
-    _tried = True
-    stale = os.path.exists(_LIB_PATH) and any(
+    with _load_lock:
+        if _lib is not None or _tried:
+            return _lib
+        try:
+            return _load_locked()
+        finally:
+            globals()["_tried"] = True
+
+
+def _is_stale() -> bool:
+    return os.path.exists(_LIB_PATH) and any(
         os.path.getmtime(os.path.join(_NATIVE_DIR, f))
         > os.path.getmtime(_LIB_PATH)
         for f in os.listdir(_NATIVE_DIR)
         if f.endswith(".cc") or f == "Makefile"
     )
-    if (not os.path.exists(_LIB_PATH) or stale) and os.path.exists(
+
+
+def _build_once() -> None:
+    """Run make under an exclusive file lock: spawn-based decode WORKERS
+    all reach first-load together, and concurrent linkers writing the
+    same .so would hand some process a partially-written library (it
+    would then silently use the slow fallback for its whole lifetime).
+    The in-process _load_lock cannot serialize across processes."""
+    import fcntl
+
+    with open(os.path.join(_NATIVE_DIR, ".build.lock"), "w") as lf:
+        fcntl.flock(lf, fcntl.LOCK_EX)
+        # another process may have built while we waited on the lock
+        if os.path.exists(_LIB_PATH) and not _is_stale():
+            return
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+
+
+def _load_locked() -> Optional[ctypes.CDLL]:
+    global _lib
+    if (not os.path.exists(_LIB_PATH) or _is_stale()) and os.path.exists(
         os.path.join(_NATIVE_DIR, "Makefile")
     ):
         try:
-            subprocess.run(
-                ["make", "-C", _NATIVE_DIR],
-                check=True,
-                capture_output=True,
-                timeout=120,
-            )
+            _build_once()
         except Exception:
             if not os.path.exists(_LIB_PATH):
                 return None
@@ -101,8 +143,103 @@ def _load() -> Optional[ctypes.CDLL]:
     return _lib
 
 
+def _load_jpeg() -> Optional[ctypes.CDLL]:
+    """The JPEG decoder lives in its own shared library (it links the
+    system libjpeg; native/Makefile builds it best-effort so the IO lib
+    survives environments without libjpeg)."""
+    global _jpeg_lib
+    if _jpeg_lib is not None or _jpeg_tried:
+        return _jpeg_lib
+    with _load_lock:
+        if _jpeg_lib is not None or _jpeg_tried:
+            return _jpeg_lib
+        try:
+            return _load_jpeg_locked()
+        finally:
+            globals()["_jpeg_tried"] = True
+
+
+def _load_jpeg_locked() -> Optional[ctypes.CDLL]:
+    global _jpeg_lib
+    _load()  # one shared build attempt covers both libraries
+    if not os.path.exists(_JPEG_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_JPEG_LIB_PATH)
+    except OSError:
+        return None
+    lib.jpeg_decode_f32.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    lib.jpeg_decode_f32.restype = ctypes.c_int
+    lib.jpeg_decode_batch_f32.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int,
+    ]
+    lib.jpeg_decode_batch_f32.restype = ctypes.c_int64
+    _jpeg_lib = lib
+    return _jpeg_lib
+
+
 def native_available() -> bool:
     return _load() is not None
+
+
+def jpeg_native_available() -> bool:
+    return _load_jpeg() is not None
+
+
+def jpeg_decode_f32(data: bytes, target: int) -> Optional[np.ndarray]:
+    """Decode one JPEG to a (target, target, 3) float32 RGB array via the
+    native fast path (native/jpeg.cc: DCT-scaled draft decode + triangle
+    resize, GIL released for the whole call). Returns None when the
+    library is unavailable or this image needs the PIL fallback (corrupt
+    stream, CMYK)."""
+    lib = _load_jpeg()
+    if lib is None:
+        return None
+    out = np.empty((target, target, 3), np.float32)
+    rc = lib.jpeg_decode_f32(
+        data, len(data), target,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    return out if rc == 0 else None
+
+
+def jpeg_decode_batch_f32(
+    blobs, target: int, num_threads: int = 0
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Decode a list of JPEG byte strings in one native call with an
+    internal thread pool. Returns ``(images (n, target, target, 3)
+    float32, ok (n,) bool)``; failed slots have undefined pixels and
+    ok=False. Returns None when the library is unavailable."""
+    lib = _load_jpeg()
+    if lib is None:
+        return None
+    n = len(blobs)
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum([len(b) for b in blobs], out=offsets[1:])
+    concat = b"".join(blobs)
+    out = np.empty((n, target, target, 3), np.float32)
+    ok = np.zeros(n, np.uint8)
+    lib.jpeg_decode_batch_f32(
+        concat,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        target,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        num_threads,
+    )
+    return out, ok.astype(bool)
 
 
 def read_csv_f32(

@@ -1,0 +1,259 @@
+// keystone-tpu native JPEG decode fast path.
+//
+// Reference capability: loaders/ImageLoaderUtils.scala:22-47 — executors
+// decode JPEGs in parallel at cluster scale. On a TPU VM the host input
+// pipeline is the analogue, and Python/PIL decoding holds the GIL enough
+// that thread pools saturate ~1 core. This library provides a C decode
+// path (libjpeg, which this image ships as libjpeg.so.62):
+//
+//   - DCT-domain scaled decode ("draft mode"): pick the largest
+//     denominator d in {1,2,4,8} with ceil(dim/d) still >= the target on
+//     both axes, so most of the inverse DCT of a large photo is skipped
+//     when decoding to 256^2.
+//   - separable triangle-filter (antialiased bilinear) resize to the
+//     exact (target, target) square — the same filter family PIL's
+//     BILINEAR resample uses, so outputs track the PIL fallback path
+//     within JPEG/resample tolerance rather than bitwise.
+//   - grayscale JPEGs are expanded to RGB by libjpeg; CMYK/YCCK (no RGB
+//     conversion in libjpeg) and malformed streams return failure and
+//     the caller falls back to PIL for that image.
+//
+// ctypes releases the GIL for the duration of each call, so the
+// streaming loader's *thread* pool scales across cores with this path
+// (no spawn+IPC tax). A batch entry point with an internal thread pool
+// is provided for bulk benchmarks.
+//
+// Built as its own shared library (libkeystone_jpeg.so) so environments
+// without libjpeg still get libkeystone_io.so.
+
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+
+namespace {
+
+// libjpeg's default error handler calls exit(); trampoline to longjmp.
+struct JumpErrorMgr {
+  jpeg_error_mgr pub;
+  std::jmp_buf jump;
+};
+
+void error_exit_trampoline(j_common_ptr cinfo) {
+  JumpErrorMgr* err = reinterpret_cast<JumpErrorMgr*>(cinfo->err);
+  std::longjmp(err->jump, 1);
+}
+
+// silent, but still counts corrupt-data warnings (msg_level < 0) the
+// way the default handler does — decode_one turns them into failure
+void emit_message_quiet(j_common_ptr cinfo, int msg_level) {
+  if (msg_level < 0) cinfo->err->num_warnings++;
+}
+
+// Separable triangle-filter resample (PIL precompute_coeffs shape):
+// support widens with the downscale factor, so minification is
+// antialiased; magnification degrades to classic bilinear.
+struct ResampleAxis {
+  std::vector<int> start;      // first source index per output pixel
+  std::vector<int> count;      // taps per output pixel
+  std::vector<float> weights;  // concatenated, count[i] each
+  int max_count = 0;
+};
+
+void build_axis(int in_size, int out_size, ResampleAxis* ax) {
+  const double scale = static_cast<double>(in_size) / out_size;
+  const double filterscale = std::max(scale, 1.0);
+  const double support = 1.0 * filterscale;  // triangle filter support
+  ax->start.resize(out_size);
+  ax->count.resize(out_size);
+  ax->weights.clear();
+  for (int xx = 0; xx < out_size; ++xx) {
+    const double center = (xx + 0.5) * scale;
+    int xmin = static_cast<int>(center - support + 0.5);
+    if (xmin < 0) xmin = 0;
+    int xmax = static_cast<int>(center + support + 0.5);
+    if (xmax > in_size) xmax = in_size;
+    double total = 0.0;
+    std::vector<double> w(xmax - xmin);
+    for (int x = xmin; x < xmax; ++x) {
+      double t = (x - center + 0.5) / filterscale;
+      double v = t < 0 ? 1.0 + t : 1.0 - t;  // triangle
+      if (v < 0.0) v = 0.0;
+      w[x - xmin] = v;
+      total += v;
+    }
+    if (total <= 0.0) {  // degenerate: nearest
+      xmin = std::min(std::max(static_cast<int>(center), 0), in_size - 1);
+      xmax = xmin + 1;
+      w.assign(1, 1.0);
+      total = 1.0;
+    }
+    ax->start[xx] = xmin;
+    ax->count[xx] = xmax - xmin;
+    ax->max_count = std::max(ax->max_count, xmax - xmin);
+    for (double v : w) ax->weights.push_back(static_cast<float>(v / total));
+  }
+}
+
+// rows: in_h x in_w x 3 uint8 -> out: target x target x 3 float32.
+void resize_rgb(const unsigned char* src, int in_w, int in_h, int target,
+                float* out) {
+  ResampleAxis hx, vx;
+  build_axis(in_w, target, &hx);
+  build_axis(in_h, target, &vx);
+  // horizontal pass: (in_h, target, 3) float
+  std::vector<float> tmp(static_cast<size_t>(in_h) * target * 3);
+  for (int y = 0; y < in_h; ++y) {
+    const unsigned char* row = src + static_cast<size_t>(y) * in_w * 3;
+    float* trow = tmp.data() + static_cast<size_t>(y) * target * 3;
+    const float* wp = hx.weights.data();
+    for (int xx = 0; xx < target; ++xx) {
+      const int s = hx.start[xx];
+      const int c = hx.count[xx];
+      float r = 0.f, g = 0.f, b = 0.f;
+      for (int k = 0; k < c; ++k) {
+        const float w = wp[k];
+        const unsigned char* px = row + (s + k) * 3;
+        r += w * px[0];
+        g += w * px[1];
+        b += w * px[2];
+      }
+      wp += c;
+      trow[xx * 3 + 0] = r;
+      trow[xx * 3 + 1] = g;
+      trow[xx * 3 + 2] = b;
+    }
+  }
+  // vertical pass
+  const float* wp = vx.weights.data();
+  for (int yy = 0; yy < target; ++yy) {
+    const int s = vx.start[yy];
+    const int c = vx.count[yy];
+    float* orow = out + static_cast<size_t>(yy) * target * 3;
+    std::memset(orow, 0, sizeof(float) * target * 3);
+    for (int k = 0; k < c; ++k) {
+      const float w = wp[k];
+      const float* trow = tmp.data() + static_cast<size_t>(s + k) * target * 3;
+      for (int i = 0; i < target * 3; ++i) orow[i] += w * trow[i];
+    }
+    wp += c;
+  }
+}
+
+int decode_one(const unsigned char* data, int64_t len, int target,
+               float* out) {
+  jpeg_decompress_struct cinfo;
+  JumpErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit_trampoline;
+  jerr.pub.emit_message = emit_message_quiet;
+  std::vector<unsigned char> pixels;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return 1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(data),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return 2;
+  }
+  if (cinfo.jpeg_color_space == JCS_CMYK ||
+      cinfo.jpeg_color_space == JCS_YCCK) {
+    // libjpeg has no CMYK->RGB conversion; caller falls back to PIL
+    jpeg_destroy_decompress(&cinfo);
+    return 3;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  // draft-mode scale, PIL Image.draft semantics: the largest power-of-2
+  // denom <= min(w//target, h//target) — floor, so the scaled image
+  // always has at least `target` FULL pixels per axis and the resize
+  // step still antialiases on both axes
+  cinfo.scale_num = 1;
+  cinfo.scale_denom = 1;
+  const unsigned floor_scale =
+      std::min(cinfo.image_width / static_cast<unsigned>(target),
+               cinfo.image_height / static_cast<unsigned>(target));
+  for (unsigned d = 8; d >= 1; d /= 2) {
+    if (d <= floor_scale) {
+      cinfo.scale_denom = d;
+      break;
+    }
+  }
+  cinfo.dct_method = JDCT_ISLOW;
+  jpeg_start_decompress(&cinfo);
+  const int w = cinfo.output_width;
+  const int h = cinfo.output_height;
+  if (w <= 0 || h <= 0 || cinfo.output_components != 3) {
+    jpeg_destroy_decompress(&cinfo);
+    return 4;
+  }
+  pixels.resize(static_cast<size_t>(w) * h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = pixels.data() + static_cast<size_t>(cinfo.output_scanline) * w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  // libjpeg "recovers" truncated/corrupt streams by gray-filling and
+  // counting a warning; the PIL fallback raises on those, so treat any
+  // warning as failure to keep the two decode paths' accept sets equal
+  const long warnings = cinfo.err->num_warnings;
+  jpeg_destroy_decompress(&cinfo);
+  if (warnings > 0) return 5;
+  resize_rgb(pixels.data(), w, h, target, out);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode one JPEG to (target, target, 3) float32 RGB. Returns 0 on
+// success; nonzero (corrupt stream / CMYK / non-RGB output) means the
+// caller should fall back to its Python decoder for this image.
+int jpeg_decode_f32(const unsigned char* data, int64_t len, int target,
+                    float* out) {
+  return decode_one(data, len, target, out);
+}
+
+// Batch decode: n JPEGs in one concatenated buffer with offsets (n+1
+// entries). out is n*target*target*3 floats; ok[i] is set to 1 on
+// success, 0 on failure (that slot's pixels are undefined). threads<=0
+// uses hardware_concurrency. Returns the number decoded successfully.
+int64_t jpeg_decode_batch_f32(const unsigned char* data,
+                              const int64_t* offsets, int64_t n, int target,
+                              float* out, unsigned char* ok, int threads) {
+  if (n <= 0) return 0;  // nt would clamp to 0 and chunk would SIGFPE
+  int nt = threads > 0 ? threads
+                       : static_cast<int>(std::thread::hardware_concurrency());
+  if (nt < 1) nt = 1;
+  if (nt > n) nt = static_cast<int>(n);
+  const size_t img_floats = static_cast<size_t>(target) * target * 3;
+  std::vector<std::thread> workers;
+  std::vector<int64_t> counts(nt, 0);
+  int64_t chunk = (n + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    workers.emplace_back([&, t]() {
+      int64_t lo = t * chunk;
+      int64_t hi = std::min(n, lo + chunk);
+      for (int64_t i = lo; i < hi; ++i) {
+        int rc = decode_one(data + offsets[i], offsets[i + 1] - offsets[i],
+                            target, out + i * img_floats);
+        ok[i] = rc == 0 ? 1 : 0;
+        if (rc == 0) ++counts[t];
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+  int64_t total = 0;
+  for (int64_t c : counts) total += c;
+  return total;
+}
+
+}  // extern "C"

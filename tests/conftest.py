@@ -10,6 +10,9 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# tests/ itself, so suites in subdirectories can import shared fixture
+# helpers (jpeg_fixtures) regardless of collection order
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from keystone_tpu.parallel.virtual import provision_devices  # noqa: E402
 

@@ -1,0 +1,50 @@
+"""Shared synthetic-JPEG fixture generator for the loader/native suites
+(one formula — smooth low-frequency content that JPEG round-trips
+closely — so decode-parity bars stay comparable across suites)."""
+
+import os
+import tarfile
+
+import numpy as np
+
+
+def jpeg_array(w, h, seed):
+    rng = np.random.default_rng(seed)
+    x, y = np.meshgrid(np.arange(w), np.arange(h))
+    img = (
+        128
+        + 80 * np.sin(x / (3 + seed % 5)) * np.cos(y / (4 + seed % 3))
+        + rng.normal(0, 4, (h, w))
+    )
+    return np.clip(
+        np.repeat(img[:, :, None], 3, axis=2), 0, 255
+    ).astype(np.uint8)
+
+
+def jpeg_bytes(w, h, seed, quality=92) -> bytes:
+    import io
+
+    from PIL import Image as PILImage
+
+    buf = io.BytesIO()
+    PILImage.fromarray(jpeg_array(w, h, seed)).save(
+        buf, format="JPEG", quality=quality
+    )
+    return buf.getvalue()
+
+
+def write_jpeg(path, w, h, seed, quality=92) -> None:
+    with open(path, "wb") as f:
+        f.write(jpeg_bytes(w, h, seed, quality))
+
+
+def make_image_tar(tar_path, wnid, n, size=(48, 40), seed0=0):
+    """A fixture tar of ``n`` small JPEGs named like ImageNet members
+    (``{wnid}_{i}.JPEG``)."""
+    tmpdir = os.path.dirname(tar_path)
+    with tarfile.open(tar_path, "w") as tf:
+        for i in range(n):
+            p = os.path.join(tmpdir, f"{wnid}_{i}.JPEG")
+            write_jpeg(p, *size, seed0 + i)
+            tf.add(p, arcname=f"{wnid}_{i}.JPEG")
+            os.unlink(p)

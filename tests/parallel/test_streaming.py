@@ -12,7 +12,6 @@ guarantee the streaming design exists for.
 import os
 import subprocess
 import sys
-import tarfile
 
 import numpy as np
 import pytest
@@ -29,33 +28,7 @@ REPO = os.path.dirname(
 )
 
 
-def _write_jpeg(path, w, h, seed):
-    from PIL import Image as PILImage
-
-    rng = np.random.default_rng(seed)
-    # smooth low-frequency content so JPEG round-trips closely
-    x, y = np.meshgrid(np.arange(w), np.arange(h))
-    img = (
-        128
-        + 80 * np.sin(x / (3 + seed % 5)) * np.cos(y / (4 + seed % 3))
-        + rng.normal(0, 4, (h, w))
-    )
-    arr = np.clip(
-        np.repeat(img[:, :, None], 3, axis=2), 0, 255
-    ).astype(np.uint8)
-    PILImage.fromarray(arr).save(path, quality=92)
-
-
-def make_image_tar(tar_path, wnid, n, size=(48, 40), seed0=0):
-    """A fixture tar of ``n`` small JPEGs named like ImageNet members
-    (``{wnid}_{i}.JPEG``)."""
-    tmpdir = os.path.dirname(tar_path)
-    with tarfile.open(tar_path, "w") as tf:
-        for i in range(n):
-            p = os.path.join(tmpdir, f"{wnid}_{i}.JPEG")
-            _write_jpeg(p, *size, seed0 + i)
-            tf.add(p, arcname=f"{wnid}_{i}.JPEG")
-            os.unlink(p)
+from jpeg_fixtures import make_image_tar  # noqa: E402  (shared generator)
 
 
 @pytest.fixture
@@ -326,3 +299,37 @@ def test_process_pool_decode_matches_threads(tar_dir):
     for (n1, l1, a1), (n2, l2, a2) in zip(proc, thread):
         assert n1 == n2 and l1 == l2
         np.testing.assert_array_equal(a1, a2)
+
+
+def test_decode_is_run_to_run_deterministic(tar_dir):
+    """Regression: the native decoder's lazy ctypes load used to race the
+    decode THREAD pool on first use — threads arriving mid-load silently
+    took the PIL fallback, so the first read of a stream decoded a
+    nondeterministic mix of native/PIL pixels. A fresh subprocess (cold
+    load, first decode inside the pool) must equal an in-process read."""
+    loc, labels = tar_dir
+    worker = (
+        "import sys, numpy as np\n"
+        "from keystone_tpu.loaders.streaming import StreamingImageNetLoader\n"
+        "arrs = [a for _, _, a in StreamingImageNetLoader(\n"
+        "    sys.argv[1], sys.argv[2], decode_size=24, shard_index=0,\n"
+        "    num_shards=1).items()]\n"
+        "np.save(sys.argv[3], np.stack(arrs))\n"
+    )
+    out = os.path.join(loc, "cold.npy")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-c", worker, loc, labels, out],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    cold = np.load(out)
+    warm = np.stack([
+        a
+        for _, _, a in StreamingImageNetLoader(
+            loc, labels, decode_size=24, shard_index=0, num_shards=1
+        ).items()
+    ])
+    np.testing.assert_array_equal(cold, warm)

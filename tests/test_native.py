@@ -104,3 +104,106 @@ def test_native_cifar_truncated_record_ignored(tmp_path):
     labels, images = read_cifar(str(p), c, dim)
     assert labels.shape == (n,)
     assert images.shape == (n, dim, dim, c)
+
+
+# -- JPEG fast path ---------------------------------------------------------
+
+
+from jpeg_fixtures import jpeg_bytes as _make_jpeg_bytes  # noqa: E402
+
+
+def test_jpeg_native_library_built():
+    from keystone_tpu.native import jpeg_native_available
+
+    # libjpeg + headers are baked into this image; the decoder must build
+    assert jpeg_native_available()
+
+
+def test_jpeg_native_matches_pil_draft_path(tmp_path):
+    """native/jpeg.cc tracks the PIL draft-decode + BILINEAR-resize
+    fallback within quantization tolerance (both decode the same DCT at
+    draft scale and use triangle-filter resampling; PIL rounds to uint8
+    after resize, the native path keeps float — so ±1 level plus a small
+    mean bound, across down- and up-scaling targets)."""
+    from keystone_tpu.loaders.streaming import _decode_payload
+    from keystone_tpu.native import jpeg_decode_f32
+
+    for seed, (w, h) in enumerate([(333, 251), (64, 80), (512, 384)]):
+        data = _make_jpeg_bytes(w, h, seed)
+        for target in (32, 96, 256):
+            nat = jpeg_decode_f32(data, target)
+            pil = _decode_payload((data, target), use_native=False)
+            assert nat is not None and pil is not None
+            assert nat.shape == pil.shape == (target, target, 3)
+            d = np.abs(nat - pil)
+            assert d.max() <= 2.0, (seed, target, d.max())
+            assert d.mean() < 0.5, (seed, target, d.mean())
+
+
+def test_jpeg_native_grayscale_expands_to_rgb():
+    import io as _io
+
+    from PIL import Image as PILImage
+
+    from keystone_tpu.native import jpeg_decode_f32
+
+    arr = (np.arange(64 * 64).reshape(64, 64) % 256).astype(np.uint8)
+    buf = _io.BytesIO()
+    PILImage.fromarray(arr, mode="L").save(buf, format="JPEG")
+    out = jpeg_decode_f32(buf.getvalue(), 32)
+    assert out is not None and out.shape == (32, 32, 3)
+    # grayscale: all three channels identical
+    np.testing.assert_array_equal(out[..., 0], out[..., 1])
+    np.testing.assert_array_equal(out[..., 0], out[..., 2])
+
+
+def test_jpeg_native_corrupt_returns_none_and_loader_falls_back(tmp_path):
+    from keystone_tpu.native import jpeg_decode_f32
+
+    assert jpeg_decode_f32(b"not a jpeg at all", 32) is None
+    # truncated stream: header ok, body gone
+    data = _make_jpeg_bytes(100, 100, 3)
+    assert jpeg_decode_f32(data[: len(data) // 4], 32) is None
+
+
+def test_jpeg_native_batch_matches_single():
+    from keystone_tpu.native import jpeg_decode_batch_f32, jpeg_decode_f32
+
+    blobs = [_make_jpeg_bytes(120, 90, s) for s in range(4)]
+    blobs.insert(2, b"corrupt")  # one bad slot must not poison the rest
+    imgs, ok = jpeg_decode_batch_f32(blobs, 48, num_threads=2)
+    assert ok.tolist() == [True, True, False, True, True]
+    for i, b in enumerate(blobs):
+        if not ok[i]:
+            continue
+        np.testing.assert_array_equal(imgs[i], jpeg_decode_f32(b, 48))
+
+
+def test_streaming_native_decode_matches_pil_decode(tmp_path):
+    """The streaming loader's native and PIL decode paths agree within
+    decode tolerance on the same tar (the pool-parity test pins the two
+    POOLS to identical bytes; this pins the two DECODERS)."""
+    import tarfile
+
+    from keystone_tpu.loaders.streaming import StreamingImageLoader
+
+    tar = tmp_path / "imgs.tar"
+    with tarfile.open(tar, "w") as tf:
+        for i in range(6):
+            p = tmp_path / f"m_{i}.JPEG"
+            p.write_bytes(_make_jpeg_bytes(90 + 7 * i, 70 + 5 * i, i))
+            tf.add(str(p), arcname=f"m_{i}.JPEG")
+
+    def mk(native):
+        return list(
+            StreamingImageLoader(
+                [str(tar)], lambda name: 0, decode_size=64,
+                use_native_decode=native,
+            ).items()
+        )
+
+    nat, pil = mk(True), mk(False)
+    assert len(nat) == len(pil) == 6
+    for (n1, _, a1), (n2, _, a2) in zip(nat, pil):
+        assert n1 == n2
+        assert np.abs(a1 - a2).max() <= 2.0
