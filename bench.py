@@ -810,6 +810,368 @@ def bench_imagenet_stream_input(n_images: int = 100_000) -> None:
          extra={"images": seen, "rss_growth_mb": round(growth, 1)})
 
 
+def bench_imagenet_stream_featurize(n_images: int = 1536) -> None:
+    """INTEGRATED host→chip path (VERDICT r4 next #1): the streaming
+    loader (native libjpeg draft decode) feeding the FULL SIFT+LCS
+    Fisher Vector ``jit_batch`` chain, with decode, H2D upload, and
+    compute overlapped through the async dispatch stream.
+
+    Reports the sustained ex/s plus each stage's standalone rate —
+    decode (host, imgs/s and imgs/s/core), upload (H2D of uint8
+    chunks), compute (device-resident featurize) — and
+    ``overlap_efficiency`` = sustained / min(stage rates): ~1.0 means
+    the pipeline loses nothing to serialization. Two environments, one
+    row:
+      * through the remote tunnel (this CI), upload is the narrow stage
+        (~70-100 imgs/s at 256² uint8) — the row then proves overlap
+        against that bound;
+      * on a TPU-VM host (PCIe H2D, many cores), decode or compute is
+        the narrow stage, and the assertion tightens to the VERDICT
+        criterion: sustained within ~10% of compute-only whenever
+        decode+upload capacity exceeds it.
+    Host RSS stays bounded — the loader never materializes the stream.
+    The stage probes are standalone sync-bounded measurements; their
+    composition through an async remote-dispatch stream is approximate
+    (deeply pipelined transfers can BEAT the standalone upload probe,
+    so overlap_efficiency may exceed 1.0 — measured 1.0-1.6 here). The
+    assertion is one-sided: sustained must not fall below 0.8x the
+    model; exceeding it only means the model is conservative.
+    Reference capability: loaders/ImageLoaderUtils.scala:22-47 decodes
+    on executors in parallel while the driver schedules compute."""
+    import os
+
+    if not (
+        os.path.exists(IMAGENET_FIXTURE_TAR)
+        and os.path.exists(IMAGENET_FIXTURE_LABELS)
+    ):
+        import sys
+
+        print("fixture tar/labels unavailable; skipping stream-featurize "
+              "bench", file=sys.stderr, flush=True)
+        return
+    from keystone_tpu.loaders.streaming import StreamingImageNetLoader
+
+    SIZE, CHUNK = 256, 128
+    rng = np.random.default_rng(0)
+    featurize = _build_fv_pipeline(rng, 64, 16).fit().jit_batch()
+
+    def feed(u8_chunk):
+        # uint8 on the wire (4x less H2D), cast on device
+        return featurize(u8_chunk.astype(jnp.float32))
+
+    def make_loader(limit, **kw):
+        probe = StreamingImageNetLoader(
+            IMAGENET_FIXTURE_TAR, IMAGENET_FIXTURE_LABELS
+        )
+        per_cycle = sum(1 for _ in probe._iter_raw())
+        return StreamingImageNetLoader(
+            IMAGENET_FIXTURE_TAR, IMAGENET_FIXTURE_LABELS,
+            decode_size=SIZE, cycle=-(-limit // per_cycle), limit=limit,
+            **kw,
+        )
+
+    # -- stage rates (each standalone) ----------------------------------
+    n_probe = 4 * CHUNK
+    t0 = time.perf_counter()
+    chunks = [
+        u8 for u8, _, _ in make_loader(n_probe).batches(CHUNK, np.uint8)
+    ]
+    decode_rate = n_probe / (time.perf_counter() - t0)
+    cores = os.cpu_count() or 1
+
+    dev = jax.devices()[0]
+    up = jax.device_put(chunks[0], dev)
+    np.asarray(up[:1, :1, :1, 0])  # warm
+    best_up = float("inf")  # tunnel transfer jitter is large; best-of-2
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for c in chunks:
+            up = jax.device_put(c, dev)
+        np.asarray(up[:1, :1, :1, 0])
+        best_up = min(best_up, time.perf_counter() - t0)
+    upload_rate = n_probe / best_up
+
+    resident = jax.device_put(chunks[0], dev)
+    np.asarray(feed(resident)[:1, :1])  # warm compile
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(len(chunks)):
+        out = feed(resident)
+    np.asarray(out[:1, :1])
+    compute_rate = n_probe / (time.perf_counter() - t0)
+
+    # -- integrated sustained run (best-of-2: tunnel jitter) ------------
+    sustained, growth = 0.0, 0.0
+    for _ in range(2):
+        seen = 0
+        rss0, peak = None, 0.0
+        out = None
+        t0 = time.perf_counter()
+        for u8, labs, n_valid in make_loader(n_images).batches(
+            CHUNK, np.uint8
+        ):
+            out = feed(jnp.asarray(u8))  # async H2D + async dispatch;
+            # the next loop iteration decodes while the chip works this
+            # chunk
+            seen += n_valid
+            if rss0 is None:
+                rss0 = _vm_rss_mb()
+            else:
+                peak = max(peak, _vm_rss_mb())
+        np.asarray(out[:1, :1])
+        dt = time.perf_counter() - t0
+        peak = max(peak, _vm_rss_mb())
+        assert seen >= n_images, (seen, n_images)
+        if seen / dt > sustained:
+            sustained = seen / dt
+            growth = peak - (rss0 or 0.0)
+
+    bottleneck = min(
+        ("decode", decode_rate), ("upload", upload_rate),
+        ("compute", compute_rate), key=lambda kv: kv[1],
+    )
+    # What a perfectly-overlapped pipeline can sustain HERE: compute
+    # runs on the chip, but decode and the Python-side upload
+    # marshalling run on host cores — with one core they serialize
+    # against each other, so the host-side bound is harmonic, not min.
+    if cores >= 2:
+        host_bound = min(decode_rate, upload_rate)
+    else:
+        host_bound = 1.0 / (1.0 / decode_rate + 1.0 / upload_rate)
+    expected = min(compute_rate, host_bound)
+    efficiency = sustained / expected
+    assert efficiency > 0.8, (
+        f"integrated pipeline runs at {sustained:.0f} ex/s but perfect "
+        f"overlap would sustain {expected:.0f} (stages: decode "
+        f"{decode_rate:.0f}, upload {upload_rate:.0f}, compute "
+        f"{compute_rate:.0f}; {cores} host core(s)) — overlap is "
+        f"broken (efficiency {efficiency:.2f})"
+    )
+    if expected == compute_rate:
+        # the VERDICT criterion proper: host feeds the chip
+        assert sustained > 0.9 * compute_rate, (
+            f"decode+upload capacity exceeds compute yet sustained "
+            f"{sustained:.0f} < 90% of compute-only {compute_rate:.0f}"
+        )
+    emit("imagenet_stream_featurize", sustained, "examples/sec/chip",
+         extra={
+             "images": seen,
+             "decode_rate": round(decode_rate, 1),
+             "decode_rate_per_core": round(decode_rate / cores, 1),
+             "host_cores": cores,
+             "upload_rate": round(upload_rate, 1),
+             "compute_rate": round(compute_rate, 1),
+             "bottleneck": bottleneck[0],
+             "expected_rate": round(expected, 1),
+             "overlap_efficiency": round(efficiency, 3),
+             "rss_growth_mb": round(growth, 1),
+         })
+
+
+def bench_stream_decode_scaling(n_images: int = 1024) -> None:
+    """Decode-pool scaling curve (VERDICT r4 next #6): host-only decode
+    imgs/s at decode_processes ∈ {0 (thread pool), 2, 4, ...} up to the
+    core count. On a 1-core host the process rows are SKIPPED (emitted
+    with skipped=true) — spawn+IPC overhead measures scheduling noise,
+    not scaling — so the 'scales with cores' claim becomes a measured
+    curve the moment multi-core hardware runs this bench. Thread/process
+    output parity is pinned by tests/parallel/test_streaming.py."""
+    import os
+
+    if not (
+        os.path.exists(IMAGENET_FIXTURE_TAR)
+        and os.path.exists(IMAGENET_FIXTURE_LABELS)
+    ):
+        import sys
+
+        print("fixture tar/labels unavailable; skipping decode-scaling "
+              "bench", file=sys.stderr, flush=True)
+        return
+    from keystone_tpu.loaders.streaming import StreamingImageNetLoader
+
+    SIZE = 256
+    probe = StreamingImageNetLoader(
+        IMAGENET_FIXTURE_TAR, IMAGENET_FIXTURE_LABELS
+    )
+    per_cycle = sum(1 for _ in probe._iter_raw())
+    cores = os.cpu_count() or 1
+    # {0, 2, 4} always appear (skipped rows included, so the curve's
+    # shape is visible in every BENCH artifact); larger pools only
+    # where the host could actually exercise them
+    pools = [0, 2, 4] + [p for p in (8, 16) if p <= cores]
+    for procs in pools:
+        name = f"stream_decode_procs_{procs}"
+        if procs > 0 and (cores < 2 or procs > cores):
+            emit(name, None, "imgs/sec", extra={
+                "skipped": True,
+                "reason": f"host has {cores} core(s); a {procs}-process "
+                "decode pool is unmeasurable here",
+            })
+            continue
+        loader = StreamingImageNetLoader(
+            IMAGENET_FIXTURE_TAR, IMAGENET_FIXTURE_LABELS,
+            decode_size=SIZE, cycle=-(-n_images // per_cycle),
+            limit=n_images, decode_processes=procs,
+        )
+        t0 = time.perf_counter()
+        seen = sum(nv for _, _, nv in loader.batches(128, np.uint8))
+        dt = time.perf_counter() - t0
+        assert seen >= n_images
+        emit(name, seen / dt, "imgs/sec",
+             extra={"host_cores": cores,
+                    "per_core": round(seen / dt / max(procs, 1), 1)})
+
+
+def _gen_host_blocks(n, d, block, k, seed=0):
+    """Host-RAM bf16 feature blocks + labels planted on block 0 (the
+    teacher lives entirely in the first block, so a fit's W must
+    concentrate there — a correctness signal that needs no full-matrix
+    cross-check at scales where none is computable)."""
+    import ml_dtypes
+
+    rng = np.random.default_rng(seed)
+    blocks = []
+    for s in range(0, d, block):
+        w = min(block, d - s)
+        blocks.append(
+            rng.standard_normal((n, w), dtype=np.float32)
+            .astype(ml_dtypes.bfloat16)
+        )
+    W1 = rng.standard_normal((blocks[0].shape[1], k)).astype(np.float32)
+    W1 *= 0.1
+    # chunked host matmul: Y depends only on block 0
+    Y = np.empty((n, k), np.float32)
+    step = 65536
+    b0 = blocks[0]
+    for r in range(0, n, step):
+        Y[r : r + step] = b0[r : r + step].astype(np.float32) @ W1
+    Y += 0.05 * rng.standard_normal((n, k), dtype=np.float32)
+    return blocks, Y, W1
+
+
+def bench_hostblocks_overlap() -> None:
+    """Out-of-aggregate-HBM training (VERDICT r4 next #2): BlockLS on a
+    host-RAM-resident feature matrix (Dataset.from_host_blocks), each
+    slab double-buffered onto the chip per pass. Reports the fit wall
+    time against its two standalone components — transfer-only (all
+    slabs device_put + sync) and compute-only (the same fit with X
+    device-resident) — and overlap_efficiency =
+    max(transfer, compute) / wall: 1.0 means the smaller component is
+    fully hidden under the larger. Through the remote tunnel transfer
+    dominates by orders of magnitude, so the row chiefly proves compute
+    hides under transfer; on PCIe-attached hardware the same row
+    becomes compute-bound and proves the reverse. Reference capability:
+    BlockLinearMapper.scala:50-73 (cluster-RAM feature cache),
+    AutoCacheRule.scala:559-602 (memory-budgeted caching)."""
+    from keystone_tpu.ops.learning import BlockLeastSquaresEstimator
+    from keystone_tpu.parallel.dataset import Dataset
+
+    N, D, K, BLOCK = 131_072, 2048, 128, 1024
+    blocks, Y, _ = _gen_host_blocks(N, D, BLOCK, K)
+    gb = sum(b.nbytes for b in blocks) / 2**30
+    Yd = Dataset.from_array(jnp.asarray(Y))
+    est = BlockLeastSquaresEstimator(block_size=BLOCK, num_iter=1, lam=0.1)
+
+    host_ds = Dataset.from_host_blocks(blocks)
+    np.asarray(est.fit(host_ds, Yd).W[:1, :1])  # warm compiles
+
+    # transfer-only: every slab H2D, one sync
+    t0 = time.perf_counter()
+    last = None
+    for b in blocks:
+        last = jax.device_put(b)
+    np.asarray(last[:1, :1])
+    t_transfer = time.perf_counter() - t0
+
+    # compute-only: same fit, X already device-resident
+    dev_ds = Dataset.from_array(
+        jnp.concatenate([jnp.asarray(b) for b in blocks], axis=1)
+    )
+    np.asarray(est.fit(dev_ds, Yd).W[:1, :1])  # warm
+    t0 = time.perf_counter()
+    np.asarray(est.fit(dev_ds, Yd).W[:1, :1])
+    t_compute = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    model = est.fit(host_ds, Yd)
+    np.asarray(model.W[:1, :1])
+    wall = time.perf_counter() - t0
+
+    efficiency = max(t_transfer, t_compute) / wall
+    assert efficiency > 0.7, (
+        f"host-blocks fit took {wall:.1f}s but its larger standalone "
+        f"component is only {max(t_transfer, t_compute):.1f}s (transfer "
+        f"{t_transfer:.1f}, compute {t_compute:.1f}) — H2D/compute "
+        f"overlap is broken"
+    )
+    emit("hostblocks_block_ls_solve", wall * 1e3, "ms", extra={
+        "features_gb": round(gb, 2),
+        "transfer_only_s": round(t_transfer, 2),
+        "compute_only_s": round(t_compute, 2),
+        "overlap_efficiency": round(efficiency, 3),
+    })
+
+
+def bench_hostblocks_xl(hbm_gb: float = 16.0) -> None:
+    """The ≥2x-HBM proof (opt-in: ``--hostblocks-xl``): fit a feature
+    matrix TWICE the chip's HBM from host RAM on the single chip —
+    1M x 16384 bf16 = 32 GiB vs v5e-lite 16 GiB — streaming each 2 GiB
+    slab through the double-buffered BCD pass. The planted teacher
+    lives in block 0, so the learned W must concentrate there: a
+    correctness check that costs O(D*K) host math instead of another
+    full pass. Not part of the default bench (through this remote
+    tunnel the 32 GiB upload alone is ~35 min); run once per round and
+    recorded in PERF. Small-scale equivalence with the in-HBM fit is
+    pinned by tests/parallel/test_host_blocks.py."""
+    from keystone_tpu.ops.learning import BlockLeastSquaresEstimator
+    from keystone_tpu.parallel.dataset import Dataset
+
+    N, D, K, BLOCK = 1_048_576, 16_384, 147, 1024
+    t0 = time.perf_counter()
+    blocks, Y, W1 = _gen_host_blocks(N, D, BLOCK, K)
+    gen_s = time.perf_counter() - t0
+    gb = sum(b.nbytes for b in blocks) / 2**30
+    hbm_multiple = gb / hbm_gb
+    assert hbm_multiple >= 2.0, (gb, hbm_gb)
+    print(json.dumps({
+        "note": "hostblocks_xl generated",
+        "features_gib": round(gb, 1),
+        "hbm_multiple": round(hbm_multiple, 2),
+        "gen_s": round(gen_s, 1),
+    }), flush=True)
+
+    est = BlockLeastSquaresEstimator(block_size=BLOCK, num_iter=1, lam=1.0)
+    t0 = time.perf_counter()
+    model = est.fit(
+        Dataset.from_host_blocks(blocks),
+        Dataset.from_array(jnp.asarray(Y)),
+    )
+    W = np.asarray(model.W)
+    wall = time.perf_counter() - t0
+
+    assert np.all(np.isfinite(W)), "non-finite model from XL fit"
+    w0 = W[: blocks[0].shape[1]]
+    cos = float(
+        np.sum(w0 * W1)
+        / (np.linalg.norm(w0) * np.linalg.norm(W1) + 1e-30)
+    )
+    off_ratio = float(
+        np.linalg.norm(W[blocks[0].shape[1]:])
+        / (np.linalg.norm(w0) + 1e-30)
+    )
+    assert cos > 0.9, f"teacher block not recovered: cos={cos:.3f}"
+    assert off_ratio < 0.5, (
+        f"weight mass leaked off the teacher block: {off_ratio:.3f}"
+    )
+    emit("hostblocks_xl_2x_hbm_solve", wall * 1e3, "ms", extra={
+        "features_gib": round(gb, 1),
+        "hbm_multiple": round(hbm_multiple, 2),
+        "effective_h2d_mb_s": round(gb * 1024 / wall, 1),
+        "teacher_cos": round(cos, 4),
+        "off_block_ratio": round(off_ratio, 4),
+    })
+
+
 def bench_imagenet_real(data_dir: str, labels_path: str,
                         val_dir: str = None, desc_dim: int = 64,
                         vocab: int = 16, num_classes: int = 1000) -> None:
@@ -922,6 +1284,9 @@ def main() -> None:
                     help="run only benches whose name contains SUBSTR")
     ap.add_argument("--stream-images", type=int, default=100_000,
                     help="image count for the streaming input row")
+    ap.add_argument("--hostblocks-xl", action="store_true",
+                    help="run ONLY the 2x-HBM host-blocks fit (slow: "
+                    "32 GiB H2D; see bench_hostblocks_xl)")
     ap.add_argument("--imagenet-data", metavar="DIR",
                     help="real ImageNet train tar dir -> parity mode")
     ap.add_argument("--imagenet-labels", metavar="FILE",
@@ -943,6 +1308,12 @@ def main() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass  # older jax without the knobs
+
+    if args.hostblocks_xl:
+        bench_hostblocks_xl()
+        if args.markdown:
+            write_markdown(args.markdown)
+        return
 
     if args.imagenet_data:
         if not args.imagenet_labels:
@@ -973,6 +1344,9 @@ def main() -> None:
         bench_imagenet_fv,
         bench_imagenet_e2e,
         bench_stream_input,
+        bench_imagenet_stream_featurize,
+        bench_stream_decode_scaling,
+        bench_hostblocks_overlap,
     ]
     benches = [
         b for b in benches if not args.only or args.only in b.__name__

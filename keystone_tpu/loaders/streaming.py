@@ -277,23 +277,27 @@ class StreamingImageLoader:
     # -- fixed-shape batches ----------------------------------------------
 
     def batches(
-        self, batch_size: int
+        self, batch_size: int, dtype=np.float32
     ) -> Iterator[Tuple[np.ndarray, List[object], int]]:
-        """(images (B, s, s, 3) float32, labels, n_valid) batches; the
-        final batch is zero-padded past n_valid. Requires decode_size."""
+        """(images (B, s, s, 3) ``dtype``, labels, n_valid) batches; the
+        final batch is zero-padded past n_valid. Requires decode_size.
+        ``dtype=np.uint8`` quarters the batch's footprint — the right
+        feed when the device program starts with a cast anyway (H2D
+        transfer of raw pixels is the narrow stage on remote-attached
+        devices)."""
         if self.decode_size is None:
             raise ValueError("batches() requires decode_size")
         s = self.decode_size
-        buf = np.zeros((batch_size, s, s, 3), np.float32)
+        buf = np.zeros((batch_size, s, s, 3), dtype)
         labels: List[object] = []
         fill = 0
         for _, label, arr in self.items():
-            buf[fill] = arr
+            buf[fill] = arr  # stores cast decode's f32 to ``dtype``
             labels.append(label)
             fill += 1
             if fill == batch_size:
                 yield buf, labels, fill
-                buf = np.zeros((batch_size, s, s, 3), np.float32)
+                buf = np.zeros((batch_size, s, s, 3), dtype)
                 labels = []
                 fill = 0
         if fill:

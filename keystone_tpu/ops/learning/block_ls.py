@@ -190,6 +190,79 @@ def _prep(X, Y, mask, n):
     return mu, mu_y, _centered_labels.__wrapped__(Y, mu_y, mask)
 
 
+@jax.jit
+def _prep_labels(Y, mask, n):
+    """Label mean + centered residual only — the host-blocks path has no
+    device-resident X to fold into the same program; feature means ride
+    each slab's first visit instead (_host_block_step first_pass)."""
+    m = mask[:, None]
+    mu_y = jnp.sum(Y.astype(jnp.float32) * m, axis=0) / n
+    return mu_y, (Y.astype(jnp.float32) - mu_y) * m
+
+
+@partial(
+    jax.jit, static_argnames=("n", "first_pass", "last_pass"),
+    donate_argnums=(1,),
+)
+def _host_block_step(Xb, R, Wb, mu_b, mask, lam, *, n: int,
+                     first_pass: bool = False, last_pass: bool = False):
+    """One BCD block update on a HOST-STREAMED slab — the same algebra
+    as ``_block_step`` operating on a whole (padded_n, w) slab instead
+    of a dynamic column slice of a device-resident X (reference:
+    BlockLinearMapper.scala:50-73 iterates feature blocks cached in
+    cluster RAM; here the slab arrived via an async ``device_put`` the
+    caller double-buffers against this program).
+
+    ``first_pass`` additionally computes the block's feature mean from
+    the slab (the in-HBM path gets all means from one ``_prep`` pass;
+    with X living on host, the mean pass rides the slab's first visit
+    — no extra transfer, one extra fused reduction)."""
+    if first_pass:
+        mu_b = (
+            jnp.sum(Xb.astype(jnp.float32) * mask[:, None], axis=0) / n
+        )
+        R_plus = R  # this block's model is exactly zero on sweep 0
+    else:
+        contrib = _f32_mm(Xb, Wb) - mask[:, None] * _f32_mm(mu_b, Wb)
+        R_plus = R + contrib
+    gram = _f32_mm(Xb.T, Xb) - n * jnp.outer(mu_b, mu_b)
+    rhs = _f32_mm(Xb.T, R_plus) - jnp.outer(mu_b, jnp.sum(R_plus, axis=0))
+    Wb_new = _psd_solve_device(gram, rhs, lam)
+    if last_pass:
+        return Wb_new, R_plus, mu_b
+    contrib_new = _f32_mm(Xb, Wb_new) - mask[:, None] * _f32_mm(mu_b, Wb_new)
+    return Wb_new, R_plus - contrib_new, mu_b
+
+
+@partial(jax.jit, static_argnames=("n",), donate_argnums=(1,))
+def _host_block_rebuild(Xb, R, Wb, mask, *, n: int):
+    """Checkpoint-resume residual rebuild for one host slab: recompute
+    the block's mean and subtract its restored model's contribution
+    (the standard path's ``_residual_update`` + the mean it would have
+    had from ``_prep``)."""
+    mu_b = jnp.sum(Xb.astype(jnp.float32) * mask[:, None], axis=0) / n
+    contrib = _f32_mm(Xb, Wb) - mask[:, None] * _f32_mm(mu_b, Wb)
+    return R - contrib, mu_b
+
+
+def _host_blocks_probe(blocks: Sequence[np.ndarray], Y) -> str:
+    """Cheap order-sensitive digest of a host-blocks dataset for
+    checkpoint fingerprints — strided row/column samples per block (a
+    full ``data_probe`` scan of a host-RAM-scale X would read the whole
+    array just to stamp a snapshot)."""
+    parts = []
+    for b in blocks:
+        rows = [0, b.shape[0] // 3, (2 * b.shape[0]) // 3, b.shape[0] - 1]
+        cols = slice(0, min(8, b.shape[1]))
+        sample = np.asarray(b[rows, cols], np.float64)
+        parts.append(
+            f"{b.shape}:{b.dtype}:"
+            + ",".join(f"{v:.6e}" for v in sample.ravel())
+        )
+    ysum = float(np.asarray(jnp.sum(Y.astype(jnp.float32))))
+    return ";".join(parts) + f"|Y={ysum:.6e}"
+
+
 @dataclasses.dataclass(eq=False)
 class BlockLinearMapper(Transformer):
     """Applies the block-solved linear model. Weights are stored as one
@@ -221,7 +294,36 @@ class BlockLinearMapper(Transformer):
         return out if icpt is None else out + icpt
 
     def apply_batch(self, ds: Dataset) -> Dataset:
+        if ds.is_host:
+            return self._apply_host_blocks(ds)
         out = _f32_mm(ds.padded(), self.W)
+        icpt = self.intercept
+        if icpt is not None:
+            out = (out + icpt) * ds.mask()[:, None]
+        return Dataset.from_array(out, n=ds.n)
+
+    def _apply_host_blocks(self, ds: Dataset) -> Dataset:
+        """Predict from a host-blocked feature matrix: stream each slab
+        (double-buffered, like the fit) and accumulate X_b W_b on
+        device — HBM holds 2 slabs + the (n, k) output, never X."""
+        blocks = ds.host_blocks
+        out = None
+        s = 0
+        nxt = jax.device_put(blocks[0])
+        for i, b in enumerate(blocks):
+            cur = nxt
+            if i + 1 < len(blocks):
+                nxt = jax.device_put(blocks[i + 1])
+            w = b.shape[1]
+            part = _f32_mm(cur, self.W[s : s + w])
+            out = part if out is None else out + part
+            s += w
+            del cur
+        if s != self.W.shape[0]:
+            raise ValueError(
+                f"host blocks cover {s} features but the model has "
+                f"{self.W.shape[0]}"
+            )
         icpt = self.intercept
         if icpt is not None:
             out = (out + icpt) * ds.mask()[:, None]
@@ -275,6 +377,8 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
         if self.solve not in ("device", "host"):
             raise ValueError(f"solve must be 'device' or 'host', got {self.solve!r}")
+        if data.is_host:
+            return self._fit_host_blocks(data, labels)
         # Mean-centering of features and labels (reference fits
         # StandardScaler(normalizeStdDev=false) per block + labels:
         # BlockLinearMapper.scala:209-215; full-width centering is
@@ -369,6 +473,119 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         return BlockLinearMapper(
             W,
             self.block_size,
+            feature_mean=mu,
+            label_mean=mu_y,
+        )
+
+    def _fit_host_blocks(self, data: Dataset, labels: Dataset
+                         ) -> BlockLinearMapper:
+        """Out-of-aggregate-HBM fit: X lives in host RAM as column
+        blocks (Dataset.from_host_blocks — the cluster-RAM feature
+        cache of BlockLinearMapper.scala:50-73 / the 75%-of-memory
+        budget of AutoCacheRule.scala:559-602); each (padded_n, w) slab
+        is transferred per pass with the NEXT slab's async ``device_put``
+        double-buffered against the current block's Gram/solve/update
+        program, so H2D rides under compute. HBM holds 2 slabs + the
+        residual, independent of D — the fit is bounded by host RAM.
+
+        The data-blocking ignores ``self.block_size``: the dataset's own
+        block layout IS the coordinate-descent blocking (matching the
+        reference, where the Seq of feature RDDs defines the blocks)."""
+        blocks = data.host_blocks
+        widths = data.block_widths
+        n = data.n
+        pn = data.padded_n
+        mask = data.mask()
+        lab = labels.to_array_mode()
+        if lab.padded_n != pn:
+            lab = lab._pad_to(pn)
+        Y = lab.padded()
+        mu_y, R = _prep_labels(Y, mask, n)
+        k = Y.shape[1]
+        nb = len(blocks)
+        Wb: List[Any] = [jnp.zeros((w, k), jnp.float32) for w in widths]
+        mu_bs: List[Any] = [None] * nb
+
+        from keystone_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.current_mesh()
+        nshards = mesh.shape[mesh_lib.DATA_AXIS]
+        # rows over the mesh's data axis when they divide evenly (the
+        # multichip layout); otherwise default single-device placement
+        sharding = (
+            mesh_lib.data_sharding(mesh) if pn % nshards == 0 else None
+        )
+
+        def put(bi: int):
+            # async H2D; jax returns immediately and the copy streams
+            # while the previous block's program occupies the chip
+            if sharding is not None:
+                return jax.device_put(blocks[bi], sharding)
+            return jax.device_put(blocks[bi])
+
+        ckpt = None
+        start_it, start_pos = 0, 0
+        if self.checkpoint_path is not None:
+            fp = (
+                f"bls-host nb={nb} widths={widths} it={self.num_iter} "
+                f"lam={self.lam} n={n} k={k} "
+                f"probe={_host_blocks_probe(blocks, Y)}"
+            )
+            ckpt = LoopCheckpointer(self.checkpoint_path,
+                                    self.checkpoint_every, fingerprint=fp)
+            state = ckpt.load()
+            if state is not None:
+                start_it = int(state["it"])
+                start_pos = int(state["pos"])
+                for bi in range(nb):
+                    if not np.any(state[f"Wb_{bi}"]):
+                        continue
+                    Wb[bi] = jnp.asarray(state[f"Wb_{bi}"], jnp.float32)
+                    R, mu_bs[bi] = _host_block_rebuild(
+                        put(bi), R, Wb[bi], mask, n=n
+                    )
+
+        def snapshot(next_it: int, next_pos: int):
+            st = {"it": next_it, "pos": next_pos}
+            for bi in range(nb):
+                st[f"Wb_{bi}"] = np.asarray(Wb[bi])
+            return st
+
+        schedule = list(two_level_schedule(
+            self.num_iter, nb, (start_it, start_pos)
+        ))
+        done = 0
+        nxt = put(schedule[0][1]) if schedule else None
+        for j, (it, bi, nxt_state) in enumerate(schedule):
+            Xb = nxt
+            if j + 1 < len(schedule):
+                nxt = put(schedule[j + 1][1])  # prefetch: double buffer
+            first = it == 0
+            mu_arg = (
+                mu_bs[bi]
+                if mu_bs[bi] is not None
+                else jnp.zeros((widths[bi],), jnp.float32)
+            )
+            Wb[bi], R, mu_bs[bi] = _host_block_step(
+                Xb, R, Wb[bi], mu_arg, mask, self.lam, n=n,
+                first_pass=first,
+                last_pass=(
+                    it == self.num_iter - 1 and bi == nb - 1
+                ),
+            )
+            del Xb  # release this slab's HBM as soon as XLA is done
+            done += 1
+            if ckpt is not None:
+                ckpt.tick(lambda: snapshot(*nxt_state))
+            if self.block_callback is not None:
+                self.block_callback(done)
+        if ckpt is not None:
+            ckpt.clear()
+        W = jnp.concatenate([jnp.asarray(w) for w in Wb], axis=0)
+        mu = jnp.concatenate(mu_bs, axis=0)
+        return BlockLinearMapper(
+            W,
+            max(widths),
             feature_mean=mu,
             label_mean=mu_y,
         )

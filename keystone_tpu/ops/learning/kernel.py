@@ -72,7 +72,16 @@ def _rbf_block(X, X_norms, gamma, mask, start, *, width):
 @dataclasses.dataclass(eq=False)
 class GaussianKernelTransformer(Transformer):
     """Holds the train set; produces kernel blocks against it (reference:
-    KernelGenerator.scala:49)."""
+    KernelGenerator.scala:49).
+
+    Precision note (ADVICE r4): the blocked cross term uses XLA's
+    3-pass bf16 GEMM (``_cross_mm_x3``, ~1.5e-5 relative error), so the
+    absolute kernel error scales as γ·1.5e-5·‖x‖². With normalized
+    features and the small γ the apps use (γ·‖x‖² ≲ 10) that is ≤1e-4
+    on kernel entries — far below solver tolerance; with LARGE
+    γ·‖x‖² (unnormalized features) kernel entries lose accuracy
+    proportionally. Normalize features (NormalizeRows) or scale γ
+    down accordingly."""
 
     train_X: Any  # (n_pad, d) device array, pad rows zero
     n_train: int

@@ -134,15 +134,30 @@ def _apply_delta(X, R, delta, start, *, width):
 
 
 def _device_memory_limit() -> int:
-    """Best-effort device HBM size in bytes (budget input for the
-    chol-path grouped-copy decision); falls back to 16 GiB (v5e) when
-    the backend reports no stats (CPU test meshes)."""
+    """Best-effort device memory size in bytes (budget input for the
+    chol-path grouped-copy decision). Accelerators without stats (the
+    axon tunnel) fall back to 16 GiB (v5e); CPU backends without stats
+    budget from HOST RAM instead — a flat 16 GiB there could drive the
+    grouped-layout decision to OOM a small CPU host (ADVICE r4), and
+    ``layout='gathered'`` stays the manual escape hatch."""
+    dev = jax.devices()[0]
     try:
-        stats = jax.devices()[0].memory_stats()
+        stats = dev.memory_stats()
         if stats and "bytes_limit" in stats:
             return int(stats["bytes_limit"])
     except Exception:
         pass
+    if dev.platform == "cpu":
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemAvailable:"):
+                        # budget a quarter of available RAM: the layout
+                        # copy competes with the data itself + the OS
+                        return int(line.split()[1]) * 1024 // 4
+        except OSError:
+            pass
+        return 4 * 1024**3
     return 16 * 1024**3
 
 
@@ -444,12 +459,21 @@ def _pcg_block_step(X, R, P, Wb, inv_counts, valid, start, w, lam,
 
 
 def _pcg_setup_core(Y, mask, w, n):
-    # labels are ±1 indicators (ClassLabelIndicators; pad rows are all
-    # zero), so class membership is simply Y > 0 — an argmax + one_hot
-    # here measured 58 ms at the flagship shape, this is ~1 ms. Rows
-    # with no positive entry (pad rows, malformed labels) belong to no
-    # class, matching the reference's indicator contract.
-    P = (Y > 0).astype(jnp.bfloat16) * mask[:, None].astype(jnp.bfloat16)
+    # Class membership must match the chol path / the reference
+    # (indexOf(label.max), i.e. argmax with first-index tie-breaking,
+    # BlockWeightedLeastSquares.scala) — an explicit argmax + one_hot
+    # measured 58 ms at the flagship shape, so membership is the FIRST
+    # positive entry per row instead: pos ∧ (cumsum(pos) == 1) is a
+    # fused ~1 ms pass, and for indicator labels (ClassLabelIndicators:
+    # entries in {−1, +1}, possibly multi-hot) every positive entry
+    # ties at +1, so first-positive IS argmax. Rows with no positive
+    # entry (pad rows, malformed labels) belong to no class. Contract:
+    # labels whose positive entries are NOT all equal (arbitrary
+    # real-valued Y) would need a true argmax — the estimator's
+    # docstring pins indicator-style labels for this path.
+    pos = Y > 0
+    first_pos = pos & (jnp.cumsum(pos, axis=1) == 1)
+    P = first_pos.astype(jnp.bfloat16) * mask[:, None].astype(jnp.bfloat16)
     counts = jnp.einsum("nc->c", P.astype(jnp.float32))
     inv_counts = 1.0 / jnp.maximum(counts, 1.0)
     valid = (counts > 0).astype(jnp.float32)
@@ -539,7 +563,15 @@ def _class_chunk_stats_gathered(
 @dataclasses.dataclass(eq=False)
 class BlockWeightedLeastSquaresEstimator(LabelEstimator):
     """fit(features, ±1 indicator labels) -> BlockLinearMapper
-    (reference: BlockWeightedLeastSquares.scala:36; weight=(3·numIter)+1)."""
+    (reference: BlockWeightedLeastSquares.scala:36; weight=(3·numIter)+1).
+
+    Label contract: indicator-style matrices (ClassLabelIndicators —
+    entries in {−1, +1}). Each row's class is its argmax with
+    first-index tie-breaking, matching the reference's
+    indexOf(label.max): multi-hot rows join exactly ONE class (the
+    first positive) in BOTH solver paths. Arbitrary real-valued Y with
+    unequal positive entries is outside the contract — the pcg path
+    keys on the first positive entry, not the largest."""
 
     block_size: int
     num_iter: int

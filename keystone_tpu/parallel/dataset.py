@@ -1,6 +1,6 @@
 """``Dataset`` — the framework's N-example collection type (the RDD stand-in).
 
-Two physical modes:
+Three physical modes:
 
 - **array mode**: a pytree of arrays (usually one matrix) with a leading
   example axis, optionally zero-padded to a multiple of the mesh's data-shard
@@ -11,6 +11,17 @@ Two physical modes:
   arrays, images of varying size, token lists). This replaces RDDs of
   non-uniform records; operators map over it on host and convert to array
   mode as soon as shapes become uniform.
+- **host-blocks mode**: a feature matrix column-blocked into HOST-RAM
+  numpy arrays (each (padded_n, w_i), C-contiguous). This is the
+  out-of-aggregate-HBM training substrate: the reference caches features
+  in cluster RAM and streams them block-by-block through the block
+  solvers (BlockLinearMapper.scala:50-73 iterates per-block feature
+  RDDs; AutoCacheRule.scala:559-602 budgets 75% of cluster memory for
+  the cache). Here host RAM is the cache tier and the BCD solvers
+  double-buffer each slab onto the chip per pass — a fit's feature
+  footprint is bounded by host RAM, not HBM. Blocks mirror the
+  reference's Seq[RDD] layout, so slabs transfer without a strided-copy
+  repack.
 
 Padding discipline: ``n`` is the valid example count; rows past ``n`` are
 zeros. Reductions that care divide by ``n`` or use ``mask()``; zero rows
@@ -46,14 +57,28 @@ class Dataset:
         *,
         arrays: Any = None,
         items: Optional[List[Any]] = None,
+        host_blocks: Optional[List[np.ndarray]] = None,
         n: Optional[int] = None,
     ):
-        if (arrays is None) == (items is None):
-            raise ValueError("exactly one of arrays/items required")
+        modes = sum(x is not None for x in (arrays, items, host_blocks))
+        if modes != 1:
+            raise ValueError(
+                "exactly one of arrays/items/host_blocks required"
+            )
         self._arrays = arrays
         self._items = items
+        self._host_blocks = host_blocks
         if arrays is not None:
             self._n = int(n) if n is not None else _leading_dim(arrays)
+        elif host_blocks is not None:
+            if not host_blocks:
+                raise ValueError("host_blocks must be non-empty")
+            rows = {b.shape[0] for b in host_blocks}
+            if len(rows) != 1:
+                raise ValueError(
+                    f"host blocks disagree on row count: {sorted(rows)}"
+                )
+            self._n = int(n) if n is not None else host_blocks[0].shape[0]
         else:
             self._n = len(items)
         self._cached = False
@@ -78,6 +103,33 @@ class Dataset:
     def from_items(items: Sequence[Any]) -> "Dataset":
         return Dataset(items=list(items))
 
+    @staticmethod
+    def from_host_blocks(
+        blocks: Sequence[np.ndarray], n: Optional[int] = None
+    ) -> "Dataset":
+        """Column-blocked feature matrix resident in host RAM (the
+        cluster-RAM feature cache of BlockLinearMapper.scala:50-73).
+        Each block is (padded_n, w_i); solvers stream one slab to the
+        device at a time, so the fit is bounded by host RAM, not HBM.
+        Blocks are made C-contiguous here (one-time cost) so every
+        later ``device_put`` is a straight memcpy, never a strided
+        repack inside the transfer path."""
+        return Dataset(
+            host_blocks=[np.ascontiguousarray(b) for b in blocks], n=n
+        )
+
+    @staticmethod
+    def from_host_array(
+        arr: np.ndarray, block_size: int, n: Optional[int] = None
+    ) -> "Dataset":
+        """Split one host matrix into contiguous column blocks (test /
+        convenience path; production featurizers emit blocks directly)."""
+        blocks = [
+            arr[:, s : s + block_size]
+            for s in range(0, arr.shape[1], block_size)
+        ]
+        return Dataset.from_host_blocks(blocks, n=n)
+
     # -- inspection --------------------------------------------------------
 
     @property
@@ -92,9 +144,25 @@ class Dataset:
         return self._arrays is not None
 
     @property
+    def is_host(self) -> bool:
+        return self._host_blocks is not None
+
+    @property
+    def host_blocks(self) -> List[np.ndarray]:
+        if self._host_blocks is None:
+            raise ValueError("not a host-blocks dataset")
+        return self._host_blocks
+
+    @property
+    def block_widths(self) -> List[int]:
+        return [b.shape[1] for b in self.host_blocks]
+
+    @property
     def padded_n(self) -> int:
         if self.is_array:
             return _leading_dim(self._arrays)
+        if self.is_host:
+            return self._host_blocks[0].shape[0]
         return self._n
 
     # -- views -------------------------------------------------------------
@@ -147,6 +215,14 @@ class Dataset:
     def to_array_mode(self) -> "Dataset":
         if self.is_array:
             return self
+        if self.is_host:
+            # materializes the WHOLE feature matrix in HBM — the thing
+            # host-blocks mode exists to avoid; legitimate only for
+            # small datasets (tests, cross-checks)
+            full = jnp.concatenate(
+                [jnp.asarray(b) for b in self._host_blocks], axis=1
+            )
+            return Dataset(arrays=full, n=self._n)
         stacked = jax.tree_util.tree_map(
             lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *self._items
         )
@@ -230,6 +306,11 @@ class Dataset:
         return self._cached
 
     def __repr__(self) -> str:
+        if self.is_host:
+            return (
+                f"Dataset(host_blocks, n={self._n}, "
+                f"widths={self.block_widths})"
+            )
         if self.is_array:
             shapes = jax.tree_util.tree_map(
                 lambda a: tuple(a.shape), self._arrays

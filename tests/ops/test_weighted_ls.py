@@ -282,3 +282,28 @@ def test_limb_splitting_recovers_f32_products():
     limbs = np.asarray(_limb3(b32, 1), np.float64)
     recon = limbs[:, :8] + limbs[:, 8:16] + limbs[:, 16:]
     assert np.abs(recon - np.asarray(b32, np.float64)).max() < 1e-7
+
+
+def test_block_weighted_multi_hot_rows_agree_across_solvers():
+    """ADVICE r4: multi-hot ±1 indicator rows must land in exactly ONE
+    class — the argmax/first-positive (identical for indicators) — in
+    BOTH solver paths, so pcg and chol fit the same systems."""
+    X, Y, _ = _weighted_problem(n=200, D=48, C=4, seed=5)
+    Y = np.asarray(Y).copy()
+    # make a third of the rows multi-hot: add a second +1 at a LATER
+    # column than the original positive (argmax keeps the first)
+    rng = np.random.default_rng(0)
+    for i in rng.choice(200, 66, replace=False):
+        c = int(np.argmax(Y[i]))
+        if c < 3:
+            Y[i, c + 1 :][rng.integers(0, 4 - c - 1)] = 1.0
+    kw = dict(block_size=48, num_iter=1, lam=0.05, mixture_weight=0.5)
+    chol = BlockWeightedLeastSquaresEstimator(solve="chol", **kw).fit(
+        Dataset.of(X), Dataset.of(Y)
+    )
+    pcg = BlockWeightedLeastSquaresEstimator(solve="pcg", **kw).fit(
+        Dataset.of(X), Dataset.of(Y)
+    )
+    np.testing.assert_allclose(
+        np.asarray(pcg.W), np.asarray(chol.W), atol=5e-4
+    )
