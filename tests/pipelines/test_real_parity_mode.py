@@ -1,0 +1,53 @@
+"""The real-data parity mode's code path, exercised on the reference
+fixture tar (VERDICT r4 next #4): ``bench.py --imagenet-data`` must
+stream tars through the full SIFT+LCS Fisher Vector chain, fit the
+weighted BCD solver, and emit train/val top-k metrics end-to-end — so
+the mode works the day a real ImageNet mount appears. Small CPU
+shapes; the 5-image fixture is one class, so the assertions pin the
+METRIC PLUMBING (rows present, errors in range, counts correct), not
+accuracy."""
+
+import os
+
+import numpy as np
+import pytest
+
+FIXTURE_TAR_DIR = "/root/reference/src/test/resources/images/imagenet"
+FIXTURE_LABELS = (
+    "/root/reference/src/test/resources/images/imagenet-test-labels"
+)
+
+pytestmark = pytest.mark.skipif(
+    not (os.path.isdir(FIXTURE_TAR_DIR) and os.path.exists(FIXTURE_LABELS)),
+    reason="reference fixture tar unavailable",
+)
+
+
+def test_parity_mode_end_to_end_on_fixture(monkeypatch):
+    import bench
+
+    rows = []
+    monkeypatch.setattr(
+        bench, "emit",
+        lambda metric, value, unit, vs=None, tflops=None, extra=None:
+        rows.append({"metric": metric, "value": value, "unit": unit,
+                     **(extra or {})}),
+    )
+    bench.bench_imagenet_real(
+        FIXTURE_TAR_DIR, FIXTURE_LABELS, val_dir=FIXTURE_TAR_DIR,
+        desc_dim=8, vocab=2, num_classes=16, size=64, batch=4,
+    )
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["metric"] == "imagenet_real_end_to_end"
+    assert row["unit"] == "examples/sec/chip"
+    assert row["value"] > 0
+    # the fixture tar holds 5 labeled images (class 12); val reuses it
+    assert row["n_train"] == 5 and row["n_val"] == 5
+    for key in ("train_top1_err", "train_top5_err",
+                "val_top1_err", "val_top5_err"):
+        assert 0.0 <= row[key] <= 1.0, (key, row[key])
+    # one class, separable: the fitted model must at least rank the
+    # true class into the top 5 of a 16-way indicator
+    assert row["train_top5_err"] == 0.0
+    assert row["val_top5_err"] == 0.0
