@@ -309,6 +309,7 @@ class BlockLinearMapper(Transformer):
         blocks = ds.host_blocks
         out = None
         s = 0
+        prev = None  # bound async run-ahead (see _fit_host_blocks)
         nxt = jax.device_put(blocks[0])
         for i, b in enumerate(blocks):
             cur = nxt
@@ -316,6 +317,9 @@ class BlockLinearMapper(Transformer):
                 nxt = jax.device_put(blocks[i + 1])
             w = b.shape[1]
             part = _f32_mm(cur, self.W[s : s + w])
+            if prev is not None:
+                jax.block_until_ready(prev)
+            prev = out
             out = part if out is None else out + part
             s += w
             del cur
@@ -544,6 +548,9 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                     R, mu_bs[bi] = _host_block_rebuild(
                         put(bi), R, Wb[bi], mask, n=n
                     )
+                    # serialize rebuild transfers (bounded HBM; resume
+                    # is rare so the lost overlap is irrelevant)
+                    jax.block_until_ready(mu_bs[bi])
 
         def snapshot(next_it: int, next_pos: int):
             st = {"it": next_it, "pos": next_pos}
@@ -556,6 +563,18 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         ))
         done = 0
         nxt = put(schedule[0][1]) if schedule else None
+        # Bound the async run-ahead: device_put allocates the slab's
+        # destination buffer at ENQUEUE time, so an unthrottled Python
+        # loop would queue every remaining slab's transfer at once —
+        # peak HBM = sum of ALL slabs (defeating the 2-slab bound) and
+        # host-side the transfer client retains the matching upload
+        # buffers (measured +60 GB transient on the 32 GiB XL fit).
+        # Waiting on the block-step output from two steps back keeps at
+        # most ~3 slabs in flight while still overlapping H2D with
+        # compute.
+        from collections import deque
+
+        inflight: deque = deque()
         for j, (it, bi, nxt_state) in enumerate(schedule):
             Xb = nxt
             if j + 1 < len(schedule):
@@ -574,6 +593,9 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                 ),
             )
             del Xb  # release this slab's HBM as soon as XLA is done
+            inflight.append(Wb[bi])
+            if len(inflight) > 2:
+                jax.block_until_ready(inflight.popleft())
             done += 1
             if ckpt is not None:
                 ckpt.tick(lambda: snapshot(*nxt_state))
