@@ -26,6 +26,7 @@ buffer is donated to avoid an HBM copy per block.
 from __future__ import annotations
 
 import dataclasses
+from collections import deque
 from functools import partial
 from typing import Any, Callable, List, Optional, Sequence
 
@@ -245,6 +246,38 @@ def _host_block_rebuild(Xb, R, Wb, mask, *, n: int):
     return R - contrib, mu_b
 
 
+def _force_sync(x) -> None:
+    """Synchronously force a queued computation by pulling one element
+    to host. ``jax.block_until_ready`` does NOT drain the remote
+    dispatch stream on tunneled devices (the repo's timing discipline —
+    bench.py:24, bin/profile-solvers ``sync()``), so a throttle built on
+    it is a no-op exactly where run-ahead hurts."""
+    np.asarray(jnp.reshape(x, (-1,))[0])
+
+
+class _RunAheadLimiter:
+    """Caps dispatched-but-unforced pipeline steps at ``window``.
+
+    ``device_put`` allocates its destination buffer at ENQUEUE time, so
+    an unthrottled host-blocks loop queues every remaining slab at once
+    — peak HBM becomes the sum of ALL slabs instead of the documented
+    2-slab bound, and the transfer client retains the matching host
+    upload buffers (measured +60 GB transient on the 32 GiB XL fit).
+    Forcing the step output from ``window`` steps back keeps at most
+    ``window + 1`` slabs in flight while H2D still rides under compute;
+    the forced sync costs one ~100 ms tunnel round trip per step, noise
+    against the multi-second slab transfers the host path exists for."""
+
+    def __init__(self, window: int = 2):
+        self._window = window
+        self._q: deque = deque()
+
+    def add(self, step_output) -> None:
+        self._q.append(step_output)
+        if len(self._q) > self._window:
+            _force_sync(self._q.popleft())
+
+
 def _host_blocks_probe(blocks: Sequence[np.ndarray], Y) -> str:
     """Cheap order-sensitive digest of a host-blocks dataset for
     checkpoint fingerprints — strided row/column samples per block (a
@@ -309,7 +342,7 @@ class BlockLinearMapper(Transformer):
         blocks = ds.host_blocks
         out = None
         s = 0
-        prev = None  # bound async run-ahead (see _fit_host_blocks)
+        limiter = _RunAheadLimiter()
         nxt = jax.device_put(blocks[0])
         for i, b in enumerate(blocks):
             cur = nxt
@@ -317,10 +350,8 @@ class BlockLinearMapper(Transformer):
                 nxt = jax.device_put(blocks[i + 1])
             w = b.shape[1]
             part = _f32_mm(cur, self.W[s : s + w])
-            if prev is not None:
-                jax.block_until_ready(prev)
-            prev = out
             out = part if out is None else out + part
+            limiter.add(out)
             s += w
             del cur
         if s != self.W.shape[0]:
@@ -550,7 +581,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                     )
                     # serialize rebuild transfers (bounded HBM; resume
                     # is rare so the lost overlap is irrelevant)
-                    jax.block_until_ready(mu_bs[bi])
+                    _force_sync(mu_bs[bi])
 
         def snapshot(next_it: int, next_pos: int):
             st = {"it": next_it, "pos": next_pos}
@@ -563,18 +594,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         ))
         done = 0
         nxt = put(schedule[0][1]) if schedule else None
-        # Bound the async run-ahead: device_put allocates the slab's
-        # destination buffer at ENQUEUE time, so an unthrottled Python
-        # loop would queue every remaining slab's transfer at once —
-        # peak HBM = sum of ALL slabs (defeating the 2-slab bound) and
-        # host-side the transfer client retains the matching upload
-        # buffers (measured +60 GB transient on the 32 GiB XL fit).
-        # Waiting on the block-step output from two steps back keeps at
-        # most ~3 slabs in flight while still overlapping H2D with
-        # compute.
-        from collections import deque
-
-        inflight: deque = deque()
+        limiter = _RunAheadLimiter()
         for j, (it, bi, nxt_state) in enumerate(schedule):
             Xb = nxt
             if j + 1 < len(schedule):
@@ -593,9 +613,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                 ),
             )
             del Xb  # release this slab's HBM as soon as XLA is done
-            inflight.append(Wb[bi])
-            if len(inflight) > 2:
-                jax.block_until_ready(inflight.popleft())
+            limiter.add(Wb[bi])
             done += 1
             if ckpt is not None:
                 ckpt.tick(lambda: snapshot(*nxt_state))
