@@ -792,52 +792,80 @@ def bench_imagenet_e2e() -> None:
 
 
 
-def bench_imagenet_e2e_hard(noise_sigma: float = 30.0) -> None:
-    """HARD variant of the end-to-end row (VERDICT r4 next #7): the
-    easy row's base-image clusters are margin-separable, so its 0.0
-    error only proves the pipeline isn't broken. Here per-example pixel
-    noise is heavy enough that FV clusters genuinely overlap: a healthy
-    featurize holds a NONZERO but bounded error band, and the row
-    carries its own negative control — the same solver fit on a
+def bench_imagenet_e2e_hard(mix_lo: float = 0.30,
+                            mix_hi: float = 0.50) -> None:
+    """HARD variant of the end-to-end row (VERDICT r4 next #7). Two
+    deliberate changes vs the easy row, each fixing a way 0.0 error
+    could be vacuous:
+
+    * **Held-out evaluation.** With D=8192 ≫ n, ridge interpolates ANY
+      training labels — train error is structurally 0 however hard the
+      workload (measured: σ=140 pixel noise still gave 0.000 train
+      top-1). Error here is measured on a disjoint validation split
+      drawn from the same generator.
+    * **Cross-class blending, not iid noise.** Fisher Vectors pool
+      thousands of descriptors, so iid pixel noise averages out
+      (σ∈{30,80,140} all measured 0.000). Each example instead blends
+      its base image with a DIFFERENT base at α ~ U(mix_lo, mix_hi):
+      approaching α=0.5 the example is genuinely ambiguous, so even a
+      perfect featurize carries an irreducible, α-tunable error.
+
+    The row carries its own negative control — the same solver on a
     collapsed featurize (all-zero features, the real bring-up failure
-    mode the e2e centroid guard once caught: a mis-wired normalization
-    collapsed every FV to the same point). The control's model ranks
-    classes by intercept alone, so its top-1 is ~chance across the
-    bases (≥0.7 here) while the healthy featurize must stay ≤0.5 —
-    separation between those two numbers is exactly what 'the
-    featurize carries signal' means on an overlapping workload."""
+    mode the e2e centroid guard once caught), whose intercept-only
+    ranking sits at ~0.8 val top-1. 'The featurize carries signal' is
+    the measured gap between the healthy band and that control. With
+    ~5 effective classes inside a 100-wide indicator, top-5 is
+    trivially near 0 — top-1 is the banded metric; top-5 is reported.
+    """
     from keystone_tpu.ops.learning import BlockWeightedLeastSquaresEstimator
     from keystone_tpu.ops.util.nodes import ClassLabelIndicators, TopKClassifier
     from keystone_tpu.parallel.dataset import Dataset
 
-    SIZE, N, C = 256, 512, 100
+    SIZE, C = 256, 100
+    N_TRAIN, N_VAL = 512, 256
+    N = N_TRAIN + N_VAL
     CHUNK = 128
     rng = np.random.default_rng(1)
     base_imgs, n_bases = _fixture_images(N, SIZE, return_n_base=True)
     base_id = np.arange(N) % n_bases
+    partner = (
+        base_id + 1 + rng.integers(0, n_bases - 1, N)
+    ) % n_bases
+    alpha = rng.uniform(mix_lo, mix_hi, N).astype(np.float32)[
+        :, None, None, None
+    ]
+    bases = base_imgs[:n_bases]
     imgs = jnp.asarray(
-        base_imgs
-        + rng.normal(0, noise_sigma, (N, SIZE, SIZE, 3)).astype(np.float32)
+        (1.0 - alpha) * bases[base_id]
+        + alpha * bases[partner]
+        + rng.normal(0, 4.0, (N, SIZE, SIZE, 3)).astype(np.float32)
     )
-    y = jnp.asarray(base_id.astype(np.int32))
+    y = base_id.astype(np.int32)
     featurize = _build_fv_pipeline(rng, 64, 16).fit().jit_batch()
     est = BlockWeightedLeastSquaresEstimator(
         block_size=4096, num_iter=1, lam=1e-3, mixture_weight=0.5,
         convergence_check="off",
     )
     top5 = TopKClassifier(5)
-    labels = ClassLabelIndicators(C).apply_batch(Dataset.from_array(y))
-    yh = np.asarray(y)
+    labels = ClassLabelIndicators(C).apply_batch(
+        Dataset.from_array(jnp.asarray(y[:N_TRAIN]))
+    )
 
-    def fit_and_errors(F):
-        feats = Dataset.from_array(F, n=N)
-        model = est.fit(feats, labels)
+    def errors(model, F, ys):
+        ds = Dataset.from_array(F, n=F.shape[0])
         preds = np.asarray(
-            top5.apply_batch(model.apply_batch(feats)).padded()[:N]
+            top5.apply_batch(model.apply_batch(ds)).padded()[: F.shape[0]]
         )
-        t5 = float(np.mean([yh[i] not in preds[i] for i in range(N)]))
-        t1 = float(np.mean(preds[:, 0] != yh))
+        t5 = float(np.mean([ys[i] not in preds[i] for i in range(len(ys))]))
+        t1 = float(np.mean(preds[:, 0] != ys))
         return t1, t5
+
+    def fit_and_val_errors(F_all):
+        model = est.fit(
+            Dataset.from_array(F_all[:N_TRAIN], n=N_TRAIN), labels
+        )
+        return errors(model, F_all[N_TRAIN:], y[N_TRAIN:])
 
     def feature_pass():
         return jnp.concatenate(
@@ -848,37 +876,39 @@ def bench_imagenet_e2e_hard(noise_sigma: float = 30.0) -> None:
     state = {}
 
     def run_once():
-        state["errs"] = fit_and_errors(feature_pass())
+        state["errs"] = fit_and_val_errors(feature_pass())
 
     run_once()  # warm
     ms, m_extra = measure(run_once, reps=2)
     dt = ms / 1e3
-    t1, t5 = state["errs"]
+    v1, v5 = state["errs"]
 
     # negative control: collapsed features -> intercept-only ranking
     F_zero = jnp.zeros((N, 2 * 2 * 64 * 16), jnp.float32)
-    c1, c5 = fit_and_errors(F_zero)
+    c1, c5 = fit_and_val_errors(F_zero)
 
-    # calibrated on the fixture images at sigma=30 (v5e, r5): healthy
-    # top-1 lands well off 0.0 but far under the control's ~0.8; a
-    # featurize that collapsed or lost its signal drifts toward the
-    # control band and trips the ceiling
-    assert 0.01 <= t1 <= 0.5, (
-        f"hard-workload top-1 {t1:.3f} outside the healthy band "
-        f"[0.01, 0.5] — below floor means the workload degenerated to "
-        f"separable (raise sigma); above ceiling means the featurize "
-        f"lost its signal (control top-1 is {c1:.3f})"
+    # calibrated on the fixture images at U(0.30, 0.50) blending (v5e,
+    # r5): healthy val top-1 lands meaningfully off 0.0 but far under
+    # the collapsed control's ~0.8; a featurize losing its signal
+    # drifts toward the control and trips the ceiling
+    assert 0.01 <= v1 <= 0.55, (
+        f"hard-workload val top-1 {v1:.3f} outside the healthy band "
+        f"[0.01, 0.55] — below floor means the blend degenerated to "
+        f"separable (raise mix range); above ceiling means the "
+        f"featurize lost its signal (control top-1 is {c1:.3f})"
     )
-    assert t5 <= 0.4, f"hard-workload top-5 {t5:.3f} > 0.4"
     assert c1 >= 0.7, (
-        f"negative control (collapsed features) top-1 {c1:.3f} < 0.7 — "
-        "the control no longer separates broken from healthy"
+        f"negative control (collapsed features) val top-1 {c1:.3f} "
+        "< 0.7 — the control no longer separates broken from healthy"
+    )
+    assert c1 - v1 >= 0.2, (
+        f"healthy ({v1:.3f}) and collapsed ({c1:.3f}) val top-1 are "
+        "too close — the row lost its discriminating power"
     )
     m_extra.update(
-        top1_err=round(t1, 4), top5_err=round(t5, 4),
-        noise_sigma=noise_sigma,
-        control_top1_err=round(c1, 4),
-        control_top5_err=round(c5, 4),
+        val_top1_err=round(v1, 4), val_top5_err=round(v5, 4),
+        mix_lo=mix_lo, mix_hi=mix_hi, n_train=N_TRAIN, n_val=N_VAL,
+        control_top1_err=round(c1, 4), control_top5_err=round(c5, 4),
     )
     emit("imagenet_sift_lcs_fv_end_to_end_hard", N / dt,
          "examples/sec/chip", extra=m_extra)
