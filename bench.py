@@ -281,11 +281,11 @@ def bench_amazon_16384(n: int = 65_000_000) -> None:
     13,631,976 ms, LS-LBFGS 52,290 ms, both reaching 11.4% train
     error). One ELL normal-equations pass + (16384,16384) solve: the
     exact solution (Block-quality) in one data pass. The Gram is
-    2·N·D² ≈ 3.5e16 dense-equivalent FLOPs — a ~4 min single-chip
-    program, so the row is timed as ONE fit (reps=1; the scan program
-    is length-dependent, so there is no cheap warm pass — the first
-    driver run pays remote compile once, later runs hit
-    /tmp/kstpu_jax_cache). Two emits mirror the 1024-feature rows:
+    2·N·D² ≈ 3.5e16 dense-equivalent FLOPs — a many-minute
+    single-chip program, so the row is OPT-IN (``--amazon-16384``),
+    timed as ONE fit (reps=1; the scan program is length-dependent, so
+    there is no cheap warm pass), run once per round and recorded in
+    PERF. Two emits mirror the 1024-feature rows:
     vs the solver with matching solution quality (Block) and vs the
     reference's fastest solver at this width (LS-LBFGS)."""
     from keystone_tpu.ops.learning import (
@@ -1522,6 +1522,9 @@ def main() -> None:
     ap.add_argument("--hostblocks-xl", action="store_true",
                     help="run ONLY the 2x-HBM host-blocks fit (slow: "
                     "32 GiB H2D; see bench_hostblocks_xl)")
+    ap.add_argument("--amazon-16384", action="store_true",
+                    help="run ONLY the Amazon 16384-feature exact "
+                    "solve (slow: ~3.5e16-FLOP Gram; recorded in PERF)")
     ap.add_argument("--imagenet-data", metavar="DIR",
                     help="real ImageNet train tar dir -> parity mode")
     ap.add_argument("--imagenet-labels", metavar="FILE",
@@ -1550,6 +1553,12 @@ def main() -> None:
             write_markdown(args.markdown)
         return
 
+    if args.amazon_16384:
+        bench_amazon_16384()
+        if args.markdown:
+            write_markdown(args.markdown)
+        return
+
     if args.imagenet_data:
         if not args.imagenet_labels:
             ap.error("--imagenet-data requires --imagenet-labels")
@@ -1571,7 +1580,6 @@ def main() -> None:
         bench_timit,
         bench_timit_lbfgs,
         bench_amazon,
-        bench_amazon_16384,
         bench_mnist,
         bench_cifar,
         bench_newsgroups,

@@ -87,6 +87,16 @@ def _psd_solve_device(gram, rhs, lam, refine=2):
             W = W + solve(rhs - jnp.matmul(A, W, precision=hp))
         return W
 
+    if gram.shape[0] > 8192:
+        # No eigh fallback at large d: lax.cond compiles BOTH branches,
+        # and eigh's QR workspace at (16384,16384) is several extra
+        # ~1 GB f32 buffers — it OOMed the 16 GiB chip alongside the
+        # Gram/data the Amazon-16384 solve holds. Cholesky breakdown
+        # (f32-rounding indefiniteness at lam≈0) then surfaces as
+        # non-finite W, which every large-d caller already asserts on;
+        # regularized fits at this scale are well inside chol's range.
+        return chol_path(L)
+
     def eigh_path(L):
         del L
         w, V = jnp.linalg.eigh(A)
