@@ -152,6 +152,15 @@ class EllLeastSquaresEstimator(LabelEstimator):
     d: int  # feature dimension (hash space size)
     lam: float = 0.0
     chunk: int = 1_000_000
+    segment_flops: float = 2.5e15  # Gram work per DISPATCH (~40 s at
+    # the measured 68 TF/s): one monolithic scan over 65M rows at
+    # d=16384 is a single ~9-minute XLA execution, which the remote
+    # worker killed twice (worker crash/restart) where shorter
+    # dispatches of the same total work complete. The bound scales
+    # with d² so small-d fits (Amazon-1024: ~2 s total) stay one
+    # dispatch — segmentation adds one ~100 ms sync per segment, noise
+    # against minutes of Gram work but 20% on a 2 s fit. G/AY
+    # accumulate across segments on device.
 
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
         data = data.to_array_mode()
@@ -173,11 +182,39 @@ class EllLeastSquaresEstimator(LabelEstimator):
                 idx, vals, Y = z(idx), z(vals), z(Y)
             chunk = min(self.chunk, max(idx.shape[0] // n_shards, 1))
             G, AY = _sharded_normal_eq(mesh, self.d, chunk)(idx, vals, Y)
-        else:
+        elif (
+            2.0 * idx.shape[0] * self.d * self.d <= self.segment_flops
+        ):
             chunk = min(self.chunk, idx.shape[0])
             G, AY = _normal_eq_pass(
                 idx, vals, Y, d=self.d, chunk=chunk
             )
+        else:
+            chunk = min(self.chunk, idx.shape[0])
+            seg_rows = int(self.segment_flops / (2.0 * self.d * self.d))
+            # a whole number of chunks per segment, at least one
+            seg = max(seg_rows // chunk, 1) * chunk
+            chunk = min(chunk, seg)
+            # pad rows to a segment multiple (zero-val rows vanish
+            # identically) so every dispatch shares one compilation
+            pad = (-idx.shape[0]) % seg
+            if pad:
+                z = lambda a: jnp.concatenate(
+                    [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)]
+                )
+                idx, vals, Y = z(idx), z(vals), z(Y)
+            G = jnp.zeros((self.d, self.d), jnp.float32)
+            AY = jnp.zeros((self.d, Y.shape[1]), jnp.float32)
+            for s in range(0, idx.shape[0], seg):
+                Gp, AYp = _normal_eq_pass(
+                    idx[s : s + seg], vals[s : s + seg], Y[s : s + seg],
+                    d=self.d, chunk=chunk,
+                )
+                G = G + Gp
+                AY = AY + AYp
+                np.asarray(G[0, 0])  # bound the dispatch queue (one
+                # RT per segment; block_until_ready does not drain the
+                # remote stream)
 
         # f32 Cholesky + iterative refinement, eigh-clamp fallback for
         # the rank-deficient lam=0 case (hash bins never hit / n < d) —

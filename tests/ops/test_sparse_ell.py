@@ -146,3 +146,26 @@ def test_ell_rank_deficient_lam_zero_is_finite():
         est.fit(ell_dataset(idx, vals), Dataset.from_array(jnp.asarray(Y))).W
     )
     assert np.isfinite(W).all()
+
+
+def test_segmented_dispatch_matches_single_pass():
+    """Forcing multi-segment accumulation (tiny segment_flops) must
+    reproduce the single-dispatch fit exactly — same Gram algebra,
+    just split across dispatches (the remote-worker robustness path
+    the Amazon-16384 row uses)."""
+    import dataclasses as dc
+
+    rng = np.random.default_rng(7)
+    n, d, nnz, k = 512, 32, 3, 2
+    idx = rng.integers(0, d, (n, nnz)).astype(np.int32)
+    vals = rng.standard_normal((n, nnz)).astype(np.float32)
+    Y = rng.standard_normal((n, k)).astype(np.float32)
+    ds = ell_dataset(jnp.asarray(idx), jnp.asarray(vals))
+    labels = Dataset.from_array(jnp.asarray(Y))
+    base = EllLeastSquaresEstimator(d=d, lam=1e-2, chunk=64)
+    single = base.fit(ds, labels)
+    # 2*512*32*32 = 1.05e6 flops; a 3e5 budget forces several segments
+    seg = dc.replace(base, segment_flops=3e5).fit(ds, labels)
+    np.testing.assert_allclose(
+        np.asarray(seg.W), np.asarray(single.W), rtol=1e-6, atol=1e-7
+    )
