@@ -619,6 +619,20 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 "layout must be 'auto', 'grouped', or 'gathered', "
                 f"got {self.layout!r}"
             )
+        if data.is_host:
+            # out-of-aggregate-HBM fit: host-RAM column blocks streamed
+            # per pass (the BlockLS host mode, block_ls.py). Only the
+            # matrix-free PCG solver applies — it is the auto choice at
+            # the wide blocks where host-blocking matters, and the chol
+            # path's class-grouped row layouts are built from a
+            # device-resident X.
+            if self.solve == "chol":
+                raise ValueError(
+                    "host-blocks datasets require the pcg solver "
+                    "(solve='auto' or 'pcg'); the chol path gathers "
+                    "class-grouped layouts from a device-resident X"
+                )
+            return self._fit_pcg_host(data, labels)
         data = data.to_array_mode()
         labels = labels.to_array_mode()
         X = data.padded()
@@ -686,6 +700,66 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 pcg_iters = its if pcg_iters is None else (
                     jnp.maximum(pcg_iters, its)
                 )
+
+        self._check_convergence(pcg_rel, pcg_iters)
+        return self._finish(blocks, Wb, joint_means, jlm, {
+            "pcg_max_rel_residual": pcg_rel,
+            "pcg_iterations": pcg_iters,
+        })
+
+    def _fit_pcg_host(self, data, labels) -> BlockLinearMapper:
+        """Weighted BCD from HOST-RAM feature blocks: each slab rides an
+        async ``device_put`` double-buffered against the previous
+        block's whole-block PCG program (same streaming discipline as
+        ``BlockLeastSquaresEstimator._fit_host_blocks``; the slab stays
+        resident for all of its block's CG iterations, so transfer
+        volume is one slab per block per sweep). The dataset's own
+        block layout IS the coordinate blocking, matching the
+        reference's Seq-of-per-block-RDDs."""
+        from keystone_tpu.ops.learning.block_ls import _RunAheadLimiter
+
+        lab = labels.to_array_mode()
+        if lab.padded_n != data.padded_n:
+            lab = lab._pad_to(data.padded_n)
+        Y = lab.padded().astype(jnp.float32)
+        n = data.n
+        mask = data.mask()
+        w = self.mixture_weight
+        host_blocks = data.host_blocks
+        widths = data.block_widths
+        starts = np.cumsum([0] + widths[:-1]).tolist()
+        blocks = list(zip(starts, widths))
+        C = Y.shape[1]
+
+        P, inv_counts, valid, jlm, R = _pcg_setup(Y, mask, w, n=n)
+        Wb = {s: jnp.zeros((wd, C), jnp.float32) for s, wd in blocks}
+        joint_means = {}
+        pcg_rel = None
+        pcg_iters = None
+        limiter = _RunAheadLimiter()
+        schedule = [
+            (it, bi)
+            for it in range(self.num_iter)
+            for bi in range(len(blocks))
+        ]
+        nxt = jax.device_put(host_blocks[schedule[0][1]])
+        for j, (it, bi) in enumerate(schedule):
+            Xb = nxt
+            if j + 1 < len(schedule):
+                nxt = jax.device_put(host_blocks[schedule[j + 1][1]])
+            s, wd = blocks[bi]
+            # the slab IS the block: start=0, width=slab width
+            Wb[s], R, jm, rel, its = _pcg_block_step(
+                Xb, R, P, Wb[s], inv_counts, valid, 0, w, self.lam,
+                width=wd, n=n, tol=self.pcg_tol,
+            )
+            joint_means[s] = jm
+            pcg_rel = rel if pcg_rel is None else jnp.maximum(pcg_rel, rel)
+            pcg_iters = (
+                its if pcg_iters is None else jnp.maximum(pcg_iters, its)
+            )
+            del Xb
+            limiter.add(Wb[s])
 
         self._check_convergence(pcg_rel, pcg_iters)
         return self._finish(blocks, Wb, joint_means, jlm, {

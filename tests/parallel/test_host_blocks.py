@@ -197,3 +197,52 @@ def test_host_fit_resume_matches_uninterrupted(tmp_path):
                                   checkpoint_every=2)
     W_res = np.asarray(resumed.fit(Xh, Yd).W)
     np.testing.assert_allclose(W_res, W_ref, rtol=2e-4, atol=2e-5)
+
+
+def test_weighted_host_fit_matches_in_hbm_pcg():
+    """The flagship solver's host-blocks path: streamed-slab PCG must
+    match the device-resident pcg fit (same block layout)."""
+    from keystone_tpu.ops.learning import BlockWeightedLeastSquaresEstimator
+
+    rng = np.random.default_rng(11)
+    n, d, C = 192, 64, 4
+    centers = rng.standard_normal((C, d)).astype(np.float32) * 2
+    yc = rng.integers(0, C, n)
+    X = (centers[yc] + rng.standard_normal((n, d))).astype(np.float32)
+    Y = (2.0 * np.eye(C, dtype=np.float32)[yc] - 1.0)
+    Yd = Dataset.from_array(jnp.asarray(Y))
+    kw = dict(block_size=32, num_iter=2, lam=0.01, mixture_weight=0.5,
+              solve="pcg")
+    dev = BlockWeightedLeastSquaresEstimator(**kw).fit(
+        Dataset.from_array(jnp.asarray(X)), Yd
+    )
+    host = BlockWeightedLeastSquaresEstimator(**kw).fit(
+        Dataset.from_host_array(X, block_size=32), Yd
+    )
+    np.testing.assert_allclose(
+        np.asarray(host.W), np.asarray(dev.W), rtol=2e-4, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(host.intercept), np.asarray(dev.intercept),
+        rtol=2e-4, atol=2e-5,
+    )
+    # and the model actually classifies
+    pred = np.asarray(
+        host.apply_batch(Dataset.from_array(jnp.asarray(X))).array()
+    )
+    assert (pred.argmax(1) == yc).mean() > 0.95
+
+
+def test_weighted_host_fit_rejects_chol():
+    from keystone_tpu.ops.learning import BlockWeightedLeastSquaresEstimator
+
+    X = np.zeros((8, 8), np.float32)
+    Y = np.ones((8, 2), np.float32)
+    with pytest.raises(ValueError, match="pcg"):
+        BlockWeightedLeastSquaresEstimator(
+            block_size=4, num_iter=1, lam=0.1, mixture_weight=0.5,
+            solve="chol",
+        ).fit(
+            Dataset.from_host_array(X, 4),
+            Dataset.from_array(jnp.asarray(Y)),
+        )
