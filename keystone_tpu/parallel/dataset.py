@@ -130,6 +130,51 @@ class Dataset:
         ]
         return Dataset.from_host_blocks(blocks, n=n)
 
+    @staticmethod
+    def host_blocks_from_batches(
+        batches, block_size: int, n: Optional[int] = None
+    ) -> "Dataset":
+        """Accumulate ROW batches of features (a featurize stream's
+        output — e.g. ``featurize(chunk)`` per loader batch) into
+        host-RAM COLUMN blocks: the glue between the out-of-core input
+        pipeline and the out-of-aggregate-HBM solvers, covering the
+        reference's featurize→cache-in-cluster-RAM→solve flow
+        (ImageNetSiftLcsFV.scala:106-142) without the features ever
+        being resident in HBM or as one host matrix.
+
+        ``batches`` yields (rows_i, D) arrays (device or host; device
+        batches are pulled to host here — on the producer side keep the
+        featurize chunk loop async and let this pull be the sync
+        point). Peak host memory is the features plus one column-block
+        copy (the per-block row chunks are freed as each block is
+        assembled)."""
+        per_block: List[List[np.ndarray]] = []
+        total = 0
+        for batch in batches:
+            host = np.asarray(batch)
+            total += host.shape[0]
+            d = host.shape[1]
+            nb = -(-d // block_size)
+            if not per_block:
+                per_block = [[] for _ in range(nb)]
+            elif len(per_block) != nb:
+                raise ValueError(
+                    f"feature width changed mid-stream: {d} vs "
+                    f"{len(per_block)} blocks of {block_size}"
+                )
+            for bi in range(nb):
+                s = bi * block_size
+                per_block[bi].append(
+                    np.ascontiguousarray(host[:, s : s + block_size])
+                )
+        if not per_block:
+            raise ValueError("empty feature stream")
+        blocks = []
+        for bi in range(len(per_block)):
+            blocks.append(np.concatenate(per_block[bi], axis=0))
+            per_block[bi] = []  # free the row chunks as we go
+        return Dataset.from_host_blocks(blocks, n=n if n is not None else total)
+
     # -- inspection --------------------------------------------------------
 
     @property
