@@ -150,24 +150,28 @@ class Dataset:
         assembled)."""
         per_block: List[List[np.ndarray]] = []
         total = 0
+        width: Optional[int] = None
         for batch in batches:
             host = np.asarray(batch)
             total += host.shape[0]
             d = host.shape[1]
-            nb = -(-d // block_size)
-            if not per_block:
-                per_block = [[] for _ in range(nb)]
-            elif len(per_block) != nb:
+            if width is None:
+                if d == 0:
+                    raise ValueError("zero-width feature batch")
+                width = d
+                per_block = [
+                    [] for _ in range(-(-d // block_size))
+                ]
+            elif d != width:
                 raise ValueError(
-                    f"feature width changed mid-stream: {d} vs "
-                    f"{len(per_block)} blocks of {block_size}"
+                    f"feature width changed mid-stream: {d} vs {width}"
                 )
-            for bi in range(nb):
+            for bi in range(len(per_block)):
                 s = bi * block_size
-                per_block[bi].append(
-                    np.ascontiguousarray(host[:, s : s + block_size])
-                )
-        if not per_block:
+                # slice views; the final per-block concatenate makes
+                # the contiguous copy exactly once
+                per_block[bi].append(host[:, s : s + block_size])
+        if width is None:
             raise ValueError("empty feature stream")
         blocks = []
         for bi in range(len(per_block)):
