@@ -20,6 +20,10 @@ from keystone_tpu.ops.nlp.tagging import (
     rule_ner_tag,
     rule_pos_tag,
 )
+from keystone_tpu.ops.nlp.crf import (
+    CRFNEREstimator,
+    CRFTaggerEstimator,
+)
 from keystone_tpu.ops.nlp.word_frequency import (
     WordFrequencyEncoder,
     WordFrequencyTransformer,
@@ -33,6 +37,8 @@ from keystone_tpu.ops.nlp.stupid_backoff import (
 )
 
 __all__ = [
+    "CRFNEREstimator",
+    "CRFTaggerEstimator",
     "FusedTextHashTF",
     "HashingTF",
     "LowerCase",

@@ -24,11 +24,11 @@ from keystone_tpu.parallel.dataset import Dataset
 from keystone_tpu.workflow.api import Estimator, Transformer
 
 
-def _features(
-    tokens: Sequence[str], i: int, prev: str, prev2: str
-) -> List[str]:
-    """Feature strings for token ``i`` given the two previous predicted
-    tags — local context + shape + affixes."""
+def _emit_features(tokens: Sequence[str], i: int) -> List[str]:
+    """Tag-history-free feature strings for token ``i`` — local context +
+    shape + affixes. This is the emission feature set shared with the CRF
+    taggers (crf.py), which model tag history through their transition
+    table instead of through features. Fixed length (8)."""
     w = tokens[i]
     lo = w.lower()
     before = tokens[i - 1].lower() if i > 0 else "<s>"
@@ -47,6 +47,16 @@ def _features(
         ),
         "pw=" + before,
         "nw=" + after,
+    ]
+
+
+def _features(
+    tokens: Sequence[str], i: int, prev: str, prev2: str
+) -> List[str]:
+    """Feature strings for token ``i`` given the two previous predicted
+    tags — the emission set plus tag-history conjunctions."""
+    lo = tokens[i].lower()
+    return _emit_features(tokens, i) + [
         "pt=" + prev,
         "pt2=" + prev2 + "|" + prev,
         "pt+w=" + prev + "|" + lo,
@@ -66,13 +76,11 @@ def _word_shape(w: str) -> str:
     return "".join(out)
 
 
-def _ner_features(
-    tokens: Sequence[str], i: int, prev: str, prev2: str
-) -> List[str]:
-    """Window features for NER (BIO tagging): identity + affixes + shape
-    of a ±2 token window, previous predicted labels, and the same
-    title/org-suffix/month cues the rule tagger keys on — learned
-    weights decide how much to trust them."""
+def _emit_ner_features(tokens: Sequence[str], i: int) -> List[str]:
+    """Tag-history-free window features for NER: identity + affixes +
+    shape of a ±2 token window, and the same title/org-suffix/month cues
+    the rule tagger keys on — learned weights decide how much to trust
+    them. Shared with the CRF NER tagger (crf.py). Fixed length (19)."""
     w = tokens[i]
     lo = w.lower()
     before = tokens[i - 1] if i > 0 else "<s>"
@@ -92,9 +100,6 @@ def _ner_features(
         "nw=" + after.lower(),
         "nshape=" + _word_shape(after),
         "n2w=" + after2.lower(),
-        "pt=" + prev,
-        "pt2=" + prev2 + "|" + prev,
-        "pt+w=" + prev + "|" + lo,
         "title" if lo.rstrip(".") in _TITLES else "notitle",
         "ptitle" if before.lower().rstrip(".") in _TITLES else "x",
         "orgsfx" if lo.rstrip(".") in _ORG_SUFFIX else "x",
@@ -102,6 +107,19 @@ def _ner_features(
         "month" if lo in _MONTHS else "x",
         "year" if re.fullmatch(r"(1[5-9]|20)\d\d", w) else "x",
         "num" if re.fullmatch(r"\d+([.,]\d+)*", w) else "x",
+    ]
+
+
+def _ner_features(
+    tokens: Sequence[str], i: int, prev: str, prev2: str
+) -> List[str]:
+    """NER features for token ``i`` given the two previous predicted
+    labels — the emission set plus label-history conjunctions."""
+    lo = tokens[i].lower()
+    return _emit_ner_features(tokens, i) + [
+        "pt=" + prev,
+        "pt2=" + prev2 + "|" + prev,
+        "pt+w=" + prev + "|" + lo,
     ]
 
 

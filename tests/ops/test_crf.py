@@ -1,0 +1,280 @@
+"""Linear-chain CRF taggers (ops/nlp/crf.py): exact inference against
+brute-force enumeration, padding invariance, a global-decoding task the
+greedy perceptron cannot solve, BIO structural guarantees, and node
+integration."""
+
+import itertools
+import pickle
+
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.nlp.crf import (
+    CRFNEREstimator,
+    CRFTaggerEstimator,
+    bio_transition_mask,
+    log_partition,
+    path_score,
+    viterbi,
+)
+from keystone_tpu.ops.nlp.external import NER, POSTagger
+from keystone_tpu.ops.nlp.tagging import PerceptronTaggerEstimator
+from keystone_tpu.parallel.dataset import Dataset
+
+
+# ---------------------------------------------------------------------------
+# Exact inference vs brute force
+# ---------------------------------------------------------------------------
+
+
+def _brute(e, trans, start):
+    """(logZ, best_path, best_score) by enumerating all T^L paths."""
+    L, T = e.shape
+    scores = {}
+    for path in itertools.product(range(T), repeat=L):
+        s = start[path[0]] + sum(e[t, path[t]] for t in range(L))
+        s += sum(trans[path[t], path[t + 1]] for t in range(L - 1))
+        scores[path] = s
+    vals = np.array(list(scores.values()))
+    m = vals.max()
+    logz = m + np.log(np.exp(vals - m).sum())
+    best = max(scores, key=scores.get)
+    return logz, list(best), scores[best]
+
+
+def test_log_partition_and_viterbi_match_brute_force():
+    rng = np.random.default_rng(0)
+    for trial in range(3):
+        L, T = 5, 3
+        e = rng.normal(size=(L, T)).astype(np.float32)
+        trans = rng.normal(size=(T, T)).astype(np.float32)
+        start = rng.normal(size=(T,)).astype(np.float32)
+        logz_ref, path_ref, best_ref = _brute(e, trans, start)
+
+        mask = np.ones(L, np.float32)
+        logz = float(log_partition(e, trans, start, mask))
+        assert abs(logz - logz_ref) < 1e-4, trial
+
+        path = np.asarray(viterbi(e, trans, start, np.int32(L)))
+        assert list(path) == path_ref, trial
+        s = float(path_score(e, trans, start, path, mask))
+        assert abs(s - best_ref) < 1e-4, trial
+
+
+def test_inference_is_padding_invariant():
+    rng = np.random.default_rng(1)
+    L, T, pad = 4, 3, 9
+    e = rng.normal(size=(L, T)).astype(np.float32)
+    trans = rng.normal(size=(T, T)).astype(np.float32)
+    start = rng.normal(size=(T,)).astype(np.float32)
+
+    e_pad = np.concatenate([e, rng.normal(size=(pad - L, T))]).astype(
+        np.float32
+    )
+    mask = (np.arange(pad) < L).astype(np.float32)
+
+    logz = float(log_partition(e, trans, start, np.ones(L, np.float32)))
+    logz_pad = float(log_partition(e_pad, trans, start, mask))
+    assert abs(logz - logz_pad) < 1e-4
+
+    path = np.asarray(viterbi(e, trans, start, np.int32(L)))
+    path_pad = np.asarray(viterbi(e_pad, trans, start, np.int32(L)))[:L]
+    assert list(path) == list(path_pad)
+
+
+# ---------------------------------------------------------------------------
+# Learning: global decode beats greedy on future-context dependence
+# ---------------------------------------------------------------------------
+
+
+def _garden_path_corpus(n=240, body=5, seed=3):
+    """Every body token is the ambiguous 'a'; the final marker token
+    ('left'/'right') determines ALL tags. Greedy left-to-right tagging
+    with a ±1-token feature window cannot see the marker from tokens
+    more than one step away; Viterbi propagates it backward through the
+    transition table."""
+    rng = np.random.default_rng(seed)
+    sents = []
+    for _ in range(n):
+        if rng.random() < 0.5:
+            toks = ["a"] * body + ["left"]
+            tags = ["X"] * (body + 1)
+        else:
+            toks = ["a"] * body + ["right"]
+            tags = ["Y"] * (body + 1)
+        sents.append((toks, tags))
+    return sents
+
+
+def test_crf_global_decode_beats_greedy_perceptron():
+    sents = _garden_path_corpus()
+    train, test = sents[:200], sents[200:]
+
+    crf = CRFTaggerEstimator(n_epochs=150, hash_dim=1 << 12).fit(
+        Dataset.from_items(train)
+    )
+    perc = PerceptronTaggerEstimator(n_iter=8).fit(Dataset.from_items(train))
+
+    def acc(tagger):
+        c = t = 0
+        for toks, gold in test:
+            pred = tagger(toks)
+            c += sum(p == g for p, g in zip(pred, gold))
+            t += len(gold)
+        return c / t
+
+    crf_acc, perc_acc = acc(crf), acc(perc)
+    # the marker + its neighbour are taggable greedily; the other 4 body
+    # tokens are a coin flip for the perceptron but exact for the CRF
+    assert crf_acc > 0.99, crf_acc
+    assert crf_acc > perc_acc + 0.2, (crf_acc, perc_acc)
+
+
+def _toy_pos_corpus():
+    """Same grammar as test_tagging._toy_corpus: DT (JJ) NN VB (RB)."""
+    dts, jjs = ["the", "a"], ["big", "small", "red", "old"]
+    nns = ["dog", "cat", "house", "tree", "car", "bird"]
+    vbs, rbs = ["runs", "sits", "falls", "jumps"], ["quickly", "slowly"]
+    rng = np.random.default_rng(0)
+    sents = []
+    for _ in range(200):
+        toks, tags = [rng.choice(dts)], ["DT"]
+        if rng.random() < 0.5:
+            toks.append(rng.choice(jjs))
+            tags.append("JJ")
+        toks.append(rng.choice(nns))
+        tags.append("NN")
+        toks.append(rng.choice(vbs))
+        tags.append("VB")
+        if rng.random() < 0.5:
+            toks.append(rng.choice(rbs))
+            tags.append("RB")
+        sents.append((toks, tags))
+    return sents
+
+
+def test_crf_pos_tagger_learns_toy_grammar_and_plugs_into_node():
+    sents = _toy_pos_corpus()
+    train, test = sents[:160], sents[160:]
+    tagger = CRFTaggerEstimator(n_epochs=150, hash_dim=1 << 14).fit(
+        Dataset.from_items(train)
+    )
+    correct = total = 0
+    for toks, gold in test:
+        pred = [t for _, t in tagger.apply(toks)]
+        correct += sum(p == g for p, g in zip(pred, gold))
+        total += len(gold)
+    assert correct / total > 0.97
+
+    node = POSTagger(annotator=tagger)
+    toks = ["the", "red", "dog", "runs"]
+    assert [t for _, t in node.apply(toks)] == ["DT", "JJ", "NN", "VB"]
+
+
+# ---------------------------------------------------------------------------
+# BIO constraints
+# ---------------------------------------------------------------------------
+
+
+def test_bio_transition_mask_shapes_and_rules():
+    names = ["B-ORG", "B-PER", "I-ORG", "I-PER", "O"]
+    tmask, smask = bio_transition_mask(names)
+    ix = {n: i for i, n in enumerate(names)}
+    # forbidden: O -> I-*, B-PER -> I-ORG, start at I-*
+    assert tmask[ix["O"], ix["I-ORG"]] < -1e8
+    assert tmask[ix["B-PER"], ix["I-ORG"]] < -1e8
+    assert smask[ix["I-PER"]] < -1e8
+    # allowed: B-ORG -> I-ORG, I-PER -> I-PER, anything -> O / B-*
+    assert tmask[ix["B-ORG"], ix["I-ORG"]] == 0
+    assert tmask[ix["I-PER"], ix["I-PER"]] == 0
+    assert tmask[ix["O"], ix["B-PER"]] == 0
+    assert (tmask[:, ix["O"]] == 0).all()
+
+
+def _bio_valid(tags):
+    prev = "O"
+    for t in tags:
+        if t.startswith("I-") and prev not in {"B-" + t[2:], "I-" + t[2:]}:
+            return False
+        prev = t
+    return True
+
+
+def test_crf_ner_constrained_decode_is_always_bio_valid():
+    # tiny, deliberately under-trained model + pathological OOV inputs:
+    # validity must come from the lattice, not from good weights
+    train = [
+        (["bob", "smith", "called"], ["B-PER", "I-PER", "O"]),
+        (["acme", "corp", "grew"], ["B-ORG", "I-ORG", "O"]),
+        (["she", "left"], ["O", "O"]),
+    ]
+    tagger = CRFNEREstimator(n_epochs=20, hash_dim=1 << 10).fit(
+        Dataset.from_items(train)
+    )
+    rng = np.random.default_rng(5)
+    vocab = ["bob", "corp", "zzq", "急", "x1", "—", "smith", "acme"]
+    for _ in range(20):
+        toks = list(rng.choice(vocab, size=rng.integers(1, 9)))
+        out = tagger(toks)
+        assert _bio_valid(out), (toks, out)
+
+
+def test_crf_ner_beats_rule_baseline():
+    from tests.ops.test_tagging import _ner_corpus, _rule_bio
+
+    sents = _ner_corpus()
+    train, test = sents[:256], sents[256:]
+    tagger = CRFNEREstimator(n_epochs=150, hash_dim=1 << 14).fit(
+        Dataset.from_items(train)
+    )
+    t_correct = r_correct = total = 0
+    for toks, gold in test:
+        pred = tagger(toks)
+        assert _bio_valid(pred), (toks, pred)
+        rule = _rule_bio(toks)
+        t_correct += sum(p == g for p, g in zip(pred, gold))
+        r_correct += sum(p == g for p, g in zip(rule, gold))
+        total += len(gold)
+    trained_acc, rule_acc = t_correct / total, r_correct / total
+    assert trained_acc > rule_acc + 0.15, (trained_acc, rule_acc)
+    assert trained_acc > 0.9, trained_acc
+
+    node = NER(annotator=tagger)
+    out = node.apply(["yesterday", "karen", "smith", "visited", "us"])
+    assert out[1:3] == ["B-PER", "I-PER"], out
+
+
+# ---------------------------------------------------------------------------
+# Round-trip + edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_crf_tagger_pickles_and_handles_empty_input():
+    train = [(["the", "dog"], ["DT", "NN"]), (["a", "cat"], ["DT", "NN"])]
+    tagger = CRFTaggerEstimator(n_epochs=30, hash_dim=1 << 10).fit(
+        Dataset.from_items(train)
+    )
+    clone = pickle.loads(pickle.dumps(tagger))
+    toks = ["the", "cat"]
+    assert clone(toks) == tagger(toks) == ["DT", "NN"]
+    assert tagger([]) == []
+    assert clone.apply([]) == []
+
+
+def test_crf_fit_rejects_all_empty_input():
+    with pytest.raises(ValueError):
+        CRFTaggerEstimator(n_epochs=1).fit(Dataset.from_items([([], [])]))
+
+
+def test_crf_ner_rejects_bio_invalid_gold():
+    # IOB1-style gold (entity opens with I-X after O) would score -1e9
+    # through the constrained lattice; must fail loudly, not silently
+    # destroy the loss
+    bad = [(["acme", "grew"], ["I-ORG", "O"])]
+    with pytest.raises(ValueError, match="BIO"):
+        CRFNEREstimator(n_epochs=1).fit(Dataset.from_items(bad))
+    # same data trains fine unconstrained
+    tagger = CRFNEREstimator(
+        n_epochs=5, hash_dim=1 << 10, constrain_bio=False
+    ).fit(Dataset.from_items(bad))
+    assert len(tagger(["acme", "grew"])) == 2
