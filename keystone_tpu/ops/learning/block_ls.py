@@ -61,18 +61,17 @@ def _f32_mm(a, b):
     )
 
 
-def _psd_solve_device(gram, rhs, lam, refine=2):
-    """(gram + lam·I) X = rhs on device, f32 Cholesky + ``refine``
-    iterative-refinement steps. Refinement recovers most of the f64 accuracy the
-    reference's driver-side LAPACK solve had (mlmatrix NormalEquations;
-    BlockLinearMapper.scala:234-240) without a host round-trip — through
-    a remote-dispatch link every host sync costs ~100 ms, so the solve
-    must stay inside the async dispatch stream. Falls back to
-    eigendecomposition with eigenvalue clamping when Cholesky breaks
-    down (indefiniteness from f32 rounding), mirroring hostsolve.py.
-    """
-    A = gram + lam * jnp.eye(gram.shape[0], dtype=gram.dtype)
-    L = jax.scipy.linalg.cholesky(A, lower=True)
+def _psd_solve_with_factor(A, L, rhs, refine=2):
+    """A X = rhs given A's (already-ridged) Cholesky factor ``L``, f32
+    + ``refine`` iterative-refinement steps. Refinement recovers most
+    of the f64 accuracy the reference's driver-side LAPACK solve had
+    (mlmatrix NormalEquations; BlockLinearMapper.scala:234-240) without
+    a host round-trip — through a remote-dispatch link every host sync
+    costs ~100 ms, so the solve must stay inside the async dispatch
+    stream. Falls back to eigendecomposition with eigenvalue clamping
+    when Cholesky breaks down (indefiniteness from f32 rounding),
+    mirroring hostsolve.py. Shared by the fresh-factor path below and
+    the cached-KRR factor bank (kernel.py _krr_cached_epoch_scan)."""
     # full-f32 matmuls: refinement converges to the residual's noise
     # floor, so the default bf16 matmul passes would cap the recovered
     # accuracy ~3 digits short
@@ -87,7 +86,7 @@ def _psd_solve_device(gram, rhs, lam, refine=2):
             W = W + solve(rhs - jnp.matmul(A, W, precision=hp))
         return W
 
-    if gram.shape[0] > 8192:
+    if A.shape[0] > 8192:
         # No eigh fallback at large d: lax.cond compiles BOTH branches,
         # and eigh's QR workspace at (16384,16384) is several extra
         # ~1 GB f32 buffers — it OOMed the 16 GiB chip alongside the
@@ -107,6 +106,14 @@ def _psd_solve_device(gram, rhs, lam, refine=2):
         )
 
     return jax.lax.cond(jnp.all(jnp.isfinite(L)), chol_path, eigh_path, L)
+
+
+def _psd_solve_device(gram, rhs, lam, refine=2):
+    """(gram + lam·I) X = rhs on device: factor, then the shared
+    refined solve (see _psd_solve_with_factor)."""
+    A = gram + lam * jnp.eye(gram.shape[0], dtype=gram.dtype)
+    L = jax.scipy.linalg.cholesky(A, lower=True)
+    return _psd_solve_with_factor(A, L, rhs, refine)
 
 
 @partial(

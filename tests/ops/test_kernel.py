@@ -124,6 +124,53 @@ def test_krr_block_permutation_still_converges(mesh8):
     np.testing.assert_allclose(np.asarray(model.model)[:n], W_exact, atol=1e-2)
 
 
+def test_krr_cached_kernel_matches_uncached():
+    """cache_kernel=True (prebuilt column blocks + batched diagonal
+    Cholesky bank) must reproduce the regenerate-per-block scan — same
+    math, restructured schedule (kernel.py _krr_cached_epoch_scan)."""
+    import dataclasses as dc
+
+    rng = np.random.default_rng(11)
+    n, d, k = 96, 5, 3
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    Y = rng.standard_normal((n, k)).astype(np.float32)
+    Xd = Dataset.from_array(jnp.asarray(X))
+    Yd = Dataset.from_array(jnp.asarray(Y))
+    base = KernelRidgeRegression(
+        GaussianKernelGenerator(gamma=0.2), lam=0.3, block_size=32,
+        num_epochs=3, block_permuter=5,
+    )
+    W_cached = np.asarray(
+        dc.replace(base, cache_kernel=True).fit(Xd, Yd).model
+    )
+    W_plain = np.asarray(
+        dc.replace(base, cache_kernel=False).fit(Xd, Yd).model
+    )
+    np.testing.assert_allclose(W_cached, W_plain, rtol=2e-5, atol=1e-6)
+    # and both sit on the reference iterates
+    K = _rbf(X, X, 0.2).astype(np.float64)
+    W_ref = _np_gauss_seidel_perm(K, Y.astype(np.float64), 0.3, 32, 3, 5)
+    np.testing.assert_allclose(W_cached[:n], W_ref, atol=1e-3)
+
+
+def _np_gauss_seidel_perm(K, Y, lam, block_size, num_epochs, permuter):
+    """_np_gauss_seidel with the estimator's per-epoch block permutation."""
+    n = K.shape[0]
+    W = np.zeros((n, Y.shape[1]))
+    n_blocks = (n + block_size - 1) // block_size
+    for epoch in range(num_epochs):
+        order = list(range(n_blocks))
+        np.random.default_rng((permuter, epoch)).shuffle(order)
+        for b in order:
+            s = b * block_size
+            e = min(s + block_size, n)
+            Kb = K[:, s:e]
+            Kbb = K[s:e, s:e]
+            rhs = Y[s:e] - (Kb.T @ W - Kbb.T @ W[s:e])
+            W[s:e] = np.linalg.solve(Kbb + lam * np.eye(e - s), rhs)
+    return W
+
+
 def test_krr_device_solve_matches_host_solve():
     import dataclasses as dc
 

@@ -1,0 +1,139 @@
+"""Component breakdown for krr_block_solve on the real chip."""
+import time, numpy as np, jax, jax.numpy as jnp
+from functools import partial
+from jax import lax
+
+N, D, K, B = 49_152, 1024, 10, 4096
+NB = N // B
+lam = 1e-2
+gamma = 1e-3
+
+kx = jax.random.PRNGKey(0)
+X = jax.random.normal(kx, (N, D), jnp.float32)
+norms = jnp.sum(X * X, axis=1)
+mask = jnp.ones((N,), jnp.float32)
+W = jnp.zeros((N, K), jnp.float32)
+Y = jax.random.normal(jax.random.PRNGKey(1), (N, K), jnp.float32)
+starts = jnp.arange(NB, dtype=jnp.int32) * B
+
+def x3(A, Bm):
+    return lax.dot_general(A, Bm, (((1,), (1,)), ((), ())),
+        precision=lax.DotAlgorithmPreset.BF16_BF16_F32_X3)
+
+def timeit(name, fn, *args, reps=3):
+    out = fn(*args); np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+        best = min(best, time.perf_counter() - t0)
+    print(f"{name:42s} {best*1e3:9.2f} ms")
+    return best
+
+# RT probe
+@jax.jit
+def rt_probe(s):
+    return s + 1.0
+timeit("tunnel RT (scalar)", rt_probe, jnp.float32(1.0))
+
+# 1. kernel-gen GEMM only (no exp), scanned over 12 blocks
+@jax.jit
+def gemm_only(X, starts):
+    def step(c, s):
+        Xb = lax.dynamic_slice_in_dim(X, s, B, axis=0)
+        d = x3(X, Xb)
+        return c + d[0, 0], None
+    c, _ = lax.scan(step, jnp.float32(0), starts)
+    return c
+timeit("12x kernel cross-GEMM (X3, no exp)", gemm_only, X, starts)
+
+# 2. full kernel block gen (with exp+mask), scanned
+@jax.jit
+def kgen(X, norms, mask, starts):
+    def step(c, s):
+        Xb = lax.dynamic_slice_in_dim(X, s, B, axis=0)
+        nb = lax.dynamic_slice_in_dim(norms, s, B, axis=0)
+        mb = lax.dynamic_slice_in_dim(mask, s, B, axis=0)
+        d2 = norms[:, None] + nb[None, :] - 2.0 * x3(X, Xb)
+        Kb = jnp.exp(-gamma * jnp.maximum(d2, 0.0)) * mask[:, None] * mb[None, :]
+        return c + Kb[0, 0], None
+    c, _ = lax.scan(step, jnp.float32(0), starts)
+    return c
+timeit("12x kernel block gen (GEMM+exp+mask)", kgen, X, norms, mask, starts)
+
+# 3. + residual contraction
+@jax.jit
+def kgen_resid(X, norms, mask, W, starts):
+    def step(c, s):
+        Xb = lax.dynamic_slice_in_dim(X, s, B, axis=0)
+        nb = lax.dynamic_slice_in_dim(norms, s, B, axis=0)
+        d2 = norms[:, None] + nb[None, :] - 2.0 * x3(X, Xb)
+        Kb = jnp.exp(-gamma * jnp.maximum(d2, 0.0))
+        r = lax.dot_general(Kb, W, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST)
+        return c + r[0, 0], None
+    c, _ = lax.scan(step, jnp.float32(0), starts)
+    return c
+timeit("  + residual K^T W (HIGHEST)", kgen_resid, X, norms, mask, W, starts)
+
+# 4. 12 sequential cholesky (scan) on a fixed PSD block
+A1 = None
+@jax.jit
+def make_psd(X):
+    Xb = lax.dynamic_slice_in_dim(X, 0, B, axis=0)
+    d2 = jnp.sum(Xb*Xb,1)[:,None] + jnp.sum(Xb*Xb,1)[None,:] - 2.0*x3(Xb, Xb)
+    Kb = jnp.exp(-gamma * jnp.maximum(d2, 0.0))
+    return Kb + lam * jnp.eye(B, dtype=jnp.float32)
+A1 = make_psd(X)
+np.asarray(A1[:1,:1])
+
+@jax.jit
+def seq_chol(A):
+    def step(c, _):
+        L = jnp.linalg.cholesky(A + c * 1e-12)
+        return c + L[0, 0], None
+    c, _ = lax.scan(step, jnp.float32(0), jnp.arange(NB))
+    return c
+timeit("12x sequential cholesky(4096) scan", seq_chol, A1)
+
+# 5. one batched cholesky (12, 4096, 4096)
+Abatch = jnp.broadcast_to(A1, (NB, B, B)) + (
+    jnp.arange(NB, dtype=jnp.float32)[:, None, None] * 1e-9)
+np.asarray(Abatch[:1, :1, :1])
+@jax.jit
+def batch_chol(Ab):
+    return jnp.linalg.cholesky(Ab)
+timeit("batched cholesky (12,4096,4096)", batch_chol, Abatch)
+
+# 6. triangular solve pair, k=10 rhs, sequential x12
+L1 = jnp.linalg.cholesky(A1)
+rhs = jax.random.normal(jax.random.PRNGKey(2), (B, K), jnp.float32)
+np.asarray(L1[:1,:1])
+@jax.jit
+def seq_trisolve(L, rhs):
+    def step(c, _):
+        z = lax.linalg.triangular_solve(L, rhs + c, left_side=True, lower=True)
+        w = lax.linalg.triangular_solve(L, z, left_side=True, lower=True,
+                                        transpose_a=True)
+        return c + w[:1, :1] * 1e-12, None
+    c, _ = lax.scan(step, rhs[:1, :1] * 0, jnp.arange(NB))
+    return c
+timeit("12x tri-solve pair (k=10)", seq_trisolve, L1, rhs)
+
+# 7. batched explicit inverse via cholesky + 2 batched tri-solves vs I
+@jax.jit
+def batch_inv(Ab):
+    L = jnp.linalg.cholesky(Ab)
+    eye = jnp.broadcast_to(jnp.eye(B, dtype=jnp.float32), Ab.shape)
+    Linv = lax.linalg.triangular_solve(L, eye, left_side=True, lower=True)
+    return lax.dot_general(Linv, Linv, (((1,), (1,)), ((2,), (2,))).__class__((((1,), (1,)), ((0,), (0,)))))
+# simpler: einsum
+@jax.jit
+def batch_inv2(Ab):
+    L = jnp.linalg.cholesky(Ab)
+    eye = jnp.broadcast_to(jnp.eye(B, dtype=jnp.float32), Ab.shape)
+    Linv = lax.linalg.triangular_solve(L, eye, left_side=True, lower=True)
+    return jnp.einsum('bki,bkj->bij', Linv, Linv)
+timeit("batched inverse (chol+trtri+gemm)", batch_inv2, Abatch)

@@ -1,0 +1,69 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from jax import lax
+N, D, K, B = 49_152, 1024, 10, 4096
+NB = N // B
+lam, gamma = 1e-2, 1e-3
+X = jax.random.normal(jax.random.PRNGKey(0), (N, D), jnp.float32)
+norms = jnp.sum(X * X, axis=1)
+mask = jnp.ones((N,), jnp.float32)
+W = jnp.zeros((N, K), jnp.float32)
+starts = jnp.arange(NB, dtype=jnp.int32) * B
+
+def x3(A, Bm):
+    return lax.dot_general(A, Bm, (((1,), (1,)), ((), ())),
+        precision=lax.DotAlgorithmPreset.BF16_BF16_F32_X3)
+
+def timeit(name, fn, *args, reps=3):
+    t0 = time.perf_counter()
+    out = fn(*args); np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    print(f"{name:42s} compile+run {time.perf_counter()-t0:6.1f} s", flush=True)
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+        best = min(best, time.perf_counter() - t0)
+    print(f"{name:42s} {best*1e3:9.2f} ms", flush=True)
+
+@jax.jit
+def rt_probe(s):
+    return s + 1.0
+timeit("tunnel RT (scalar)", rt_probe, jnp.float32(1.0))
+
+@jax.jit
+def gemm_only(X, starts):
+    def step(c, s):
+        Xb = lax.dynamic_slice_in_dim(X, s, B, axis=0)
+        d = x3(X, Xb)
+        return c + d[0, 0], None
+    c, _ = lax.scan(step, jnp.float32(0), starts)
+    return c
+timeit("12x kernel cross-GEMM (X3, no exp)", gemm_only, X, starts)
+
+@jax.jit
+def kgen(X, norms, mask, starts):
+    def step(c, s):
+        Xb = lax.dynamic_slice_in_dim(X, s, B, axis=0)
+        nb = lax.dynamic_slice_in_dim(norms, s, B, axis=0)
+        mb = lax.dynamic_slice_in_dim(mask, s, B, axis=0)
+        d2 = norms[:, None] + nb[None, :] - 2.0 * x3(X, Xb)
+        Kb = jnp.exp(-gamma * jnp.maximum(d2, 0.0)) * mask[:, None] * mb[None, :]
+        return c + Kb[0, 0], None
+    c, _ = lax.scan(step, jnp.float32(0), starts)
+    return c
+timeit("12x kernel block gen (GEMM+exp+mask)", kgen, X, norms, mask, starts)
+
+@jax.jit
+def kgen_resid(X, norms, mask, W, starts):
+    def step(c, s):
+        Xb = lax.dynamic_slice_in_dim(X, s, B, axis=0)
+        nb = lax.dynamic_slice_in_dim(norms, s, B, axis=0)
+        d2 = norms[:, None] + nb[None, :] - 2.0 * x3(X, Xb)
+        Kb = jnp.exp(-gamma * jnp.maximum(d2, 0.0))
+        r = lax.dot_general(Kb, W, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST)
+        return c + r[0, 0], None
+    c, _ = lax.scan(step, jnp.float32(0), starts)
+    return c
+timeit("  + residual K^T W (HIGHEST)", kgen_resid, X, norms, mask, W, starts)

@@ -1,0 +1,55 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from jax import lax
+N, D, K, B = 49_152, 1024, 10, 4096
+NB = N // B
+lam, gamma = 1e-2, 1e-3
+X = jax.random.normal(jax.random.PRNGKey(0), (N, D), jnp.float32)
+
+def x3(A, Bm):
+    return lax.dot_general(A, Bm, (((1,), (1,)), ((), ())),
+        precision=lax.DotAlgorithmPreset.BF16_BF16_F32_X3)
+
+def timeit(name, fn, *args, reps=3):
+    t0 = time.perf_counter()
+    out = fn(*args); np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    print(f"{name:44s} compile+run {time.perf_counter()-t0:6.1f} s", flush=True)
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+        best = min(best, time.perf_counter() - t0)
+    print(f"{name:44s} {best*1e3:9.2f} ms", flush=True)
+
+@jax.jit
+def rt_probe(s):
+    return s + 1.0
+timeit("tunnel RT (scalar)", rt_probe, jnp.float32(1.0))
+
+@jax.jit
+def make_psd_scan(X):
+    def one(c, i):
+        Xb = lax.dynamic_slice_in_dim(X, i * B, B, axis=0)
+        nb = jnp.sum(Xb * Xb, 1)
+        d2 = nb[:, None] + nb[None, :] - 2.0 * x3(Xb, Xb)
+        Kb = jnp.exp(-gamma * jnp.maximum(d2, 0.0))
+        return c, Kb + lam * jnp.eye(B, dtype=jnp.float32)
+    _, Ab = lax.scan(one, jnp.float32(0), jnp.arange(NB))
+    return Ab
+Ab = make_psd_scan(X)
+np.asarray(Ab[:1, :1, :1])
+timeit("scan K_BB build (12 diag blocks)", make_psd_scan, X)
+
+@jax.jit
+def seq_chol(Ab):
+    def step(c, i):
+        L = jnp.linalg.cholesky(Ab[i] + c * 1e-12)
+        return c + L.sum() * 1e-20, None
+    c, _ = lax.scan(step, jnp.float32(0), jnp.arange(NB))
+    return c
+timeit("12x sequential cholesky(4096) scan", seq_chol, Ab)
+
+@jax.jit
+def batch_chol(Ab):
+    return jnp.linalg.cholesky(Ab).sum()
+timeit("batched cholesky (12,4096,4096)", batch_chol, Ab)
