@@ -59,11 +59,17 @@ class NaiveBayesEstimator(LabelEstimator):
     lam: float = 1.0
 
     def fit(self, data: Dataset, labels: Dataset) -> NaiveBayesModel:
-        y = np.asarray(labels.array()).reshape(-1).astype(np.int64)
+        # whole fit stays in the dispatch stream: pulling the labels to
+        # the host costs a full tunnel round-trip (~100 ms) on remote
+        # devices and forces the async pipeline to drain
+        y = jnp.asarray(labels.array()).reshape(-1)
         x = data.padded()
-        onehot = jnp.asarray(
-            np.eye(self.num_classes, dtype=np.float32)[y]
-        )
+        onehot = jax.nn.one_hot(y, self.num_classes, dtype=jnp.float32)
+        # one_hot maps out-of-range labels to a zero row, which would
+        # silently drop those samples (np.eye indexing used to raise);
+        # poison the model with NaN instead — loud, but still sync-free
+        bad = jnp.any((y < 0) | (y >= self.num_classes))
+        onehot = jnp.where(bad, jnp.nan, onehot)
         # pad rows of x are zero so the (k, d) count matmul is exact
         if isinstance(x, jsparse.BCOO):
             counts = jsparse.bcoo_dot_general(
@@ -72,10 +78,10 @@ class NaiveBayesEstimator(LabelEstimator):
             ).T
         else:
             counts = mm(_pad_rows(onehot, x.shape[0]).T, x)
-        class_counts = np.bincount(y, minlength=self.num_classes)
-        pi = jnp.log(
-            (jnp.asarray(class_counts, jnp.float32) + self.lam)
-        ) - np.log(len(y) + self.num_classes * self.lam)
+        class_counts = onehot.sum(axis=0)
+        pi = jnp.log(class_counts + self.lam) - np.log(
+            y.shape[0] + self.num_classes * self.lam
+        )
         totals = jnp.sum(counts, axis=1, keepdims=True)
         theta = jnp.log(counts + self.lam) - jnp.log(
             totals + self.lam * counts.shape[1]

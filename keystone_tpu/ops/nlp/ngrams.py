@@ -48,13 +48,14 @@ class NGramsFeaturizer(Transformer):
     def apply(self, tokens: Sequence) -> List[List]:
         lo = min(self.orders)
         hi = max(self.orders)
+        toks = list(tokens)  # one copy; list slices below are fresh lists
         out: List[List] = []
-        n = len(tokens)
+        append = out.append
+        n = len(toks)
         for i in range(n - lo + 1):
-            for order in range(lo, hi + 1):
-                if i + order > n:
-                    break
-                out.append(list(tokens[i : i + order]))
+            top = i + min(hi, n - i)
+            for j in range(i + lo, top + 1):
+                append(toks[i:j])
         return out
 
     def eq_key(self):

@@ -13,6 +13,7 @@ mesh's data axis automatically under jit.
 from __future__ import annotations
 
 import dataclasses
+from collections import Counter
 from functools import partial
 from typing import Any, Callable, Optional
 
@@ -294,11 +295,12 @@ class TermFrequency(Transformer):
     vmap_batch = False
 
     def apply(self, terms):
-        counts: dict = {}
-        for t in terms:
-            if isinstance(t, list):  # ngram lists -> hashable tuples
-                t = tuple(t)
-            counts[t] = counts.get(t, 0) + 1
+        # Counter consumes the generator at C speed — this node is on
+        # the hot host path of every text pipeline (ngram lists become
+        # hashable tuples on the way in)
+        counts = Counter(
+            tuple(t) if isinstance(t, list) else t for t in terms
+        )
         return {k: self.fn(v) for k, v in counts.items()}
 
     def eq_key(self):

@@ -292,8 +292,10 @@ class CommonSparseFeatures(Estimator):
     def fit(self, data: Dataset) -> SparseFeatureVectorizer:
         counts: Counter = Counter()
         for item in data.items():
-            for k, v in item.items():
-                counts[k] += 1 if v != 0 else 0
+            # every occurrence counts once, value included-but-ignored —
+            # CommonSparseFeatures.scala:37 flatMaps all (feature, value)
+            # pairs with weight 1 regardless of the value
+            counts.update(item.keys())
         top = [k for k, _ in counts.most_common(self.num_features)]
         index = {k: i for i, k in enumerate(top)}
         return SparseFeatureVectorizer(index, self.num_features)

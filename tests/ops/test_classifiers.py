@@ -30,6 +30,20 @@ def test_naive_bayes_separates_counts():
     assert (scores.argmax(1) == y).all()
 
 
+def test_naive_bayes_out_of_range_labels_fail_loudly():
+    # one_hot would silently zero out-of-range labels; the fit instead
+    # poisons the model with NaN (sync-free device-side guard), so the
+    # mis-specification cannot pass as a trained model
+    X = np.array([[1, 0], [0, 1]], np.float32)
+    y = np.array([1, 2])  # 1-based labels with num_classes=2
+    model = NaiveBayesEstimator(2).fit(Dataset.of(X), Dataset.of(y))
+    scores = np.asarray(model.apply_batch(Dataset.of(X)).array())
+    assert np.isnan(scores).all()
+    # in-range labels stay NaN-free
+    ok = NaiveBayesEstimator(2).fit(Dataset.of(X), Dataset.of(y - 1))
+    assert np.isfinite(np.asarray(ok.apply_batch(Dataset.of(X)).array())).all()
+
+
 def test_logistic_regression_separates(mesh8):
     rng = np.random.default_rng(0)
     n = 200

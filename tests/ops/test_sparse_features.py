@@ -1,0 +1,95 @@
+"""Sparse feature selection/vectorization (ops/util/nodes.py) — port of
+the reference SparseFeatureVectorizerSuite (nodes/misc/
+SparseFeatureVectorizerSuite.scala) plus the occurrence-counting and
+tie-break determinism contracts of CommonSparseFeatures.scala:14-16,37."""
+
+import numpy as np
+
+from keystone_tpu.ops.util.nodes import (
+    AllSparseFeatures,
+    CommonSparseFeatures,
+    SparseFeatureVectorizer,
+)
+from keystone_tpu.parallel.dataset import Dataset
+
+
+def _dense(bcoo):
+    return np.asarray(bcoo.todense())
+
+
+def test_sparse_feature_vectorization():
+    # SparseFeatureVectorizerSuite "sparse feature vectorization"
+    vec = SparseFeatureVectorizer(
+        {"First": 0, "Second": 1, "Third": 2}, dim=3
+    )
+    out = _dense(vec.apply({"Third": 4.0, "Fourth": 6.0, "First": 1.0}))
+    assert out.shape == (3,)
+    assert out.tolist() == [1.0, 0.0, 4.0]
+
+
+def test_all_sparse_feature_selection():
+    # SparseFeatureVectorizerSuite "all sparse feature selection"
+    train = Dataset.from_items(
+        [{"First": 0.0, "Second": 6.0}, {"Third": 3.0, "Second": 4.0}]
+    )
+    vec = AllSparseFeatures().fit(train)
+    out = _dense(vec.apply({"Third": 4.0, "Fourth": 6.0, "First": 1.0}))
+    got = {k: out[i] for k, i in vec.feature_index.items()}
+    assert set(vec.feature_index) == {"First", "Second", "Third"}
+    assert got["First"] == 1.0 and got["Second"] == 0.0
+    assert got["Third"] == 4.0
+
+
+def test_common_sparse_feature_selection():
+    # SparseFeatureVectorizerSuite "common sparse feature selection":
+    # Second appears 3x, Third 2x -> the top-2 vocabulary. "First"
+    # appears once WITH VALUE 0.0 — it still counts as an occurrence
+    # (CommonSparseFeatures.scala:37 flatMaps every (feature, value)
+    # pair with weight 1) but loses on count.
+    train = Dataset.from_items([
+        {"First": 0.0, "Second": 6.0},
+        {"Third": 3.0, "Second": 4.8},
+        {"Third": 7.0, "Fourth": 5.0},
+        {"Fifth": 5.0, "Second": 7.3},
+    ])
+    vec = CommonSparseFeatures(2).fit(train)
+    assert set(vec.feature_index) == {"Second", "Third"}
+    out = _dense(vec.apply({
+        "Third": 4.0, "Seventh": 8.0, "Second": 1.3, "Fourth": 6.0,
+        "First": 1.0,
+    }))
+    got = {k: out[i] for k, i in vec.feature_index.items()}
+    assert got["Second"] == np.float32(1.3) and got["Third"] == 4.0
+
+
+def test_common_sparse_zero_valued_occurrences_count():
+    # a feature seen twice with value 0 outranks one seen once with a
+    # large value — selection is by occurrence count, never by value
+    train = Dataset.from_items([
+        {"zero": 0.0, "big": 100.0},
+        {"zero": 0.0},
+    ])
+    vec = CommonSparseFeatures(1).fit(train)
+    assert list(vec.feature_index) == ["zero"]
+
+
+def test_common_sparse_tie_break_is_earliest_appearance():
+    # equal counts -> earliest-seen feature wins the top-k cutoff
+    # (the reference's zipWithUniqueId min-id tie break,
+    # CommonSparseFeatures.scala:14-16,40-42)
+    train = Dataset.from_items([
+        {"a": 1.0}, {"b": 1.0}, {"c": 1.0},
+        {"a": 1.0}, {"b": 1.0}, {"c": 1.0},
+    ])
+    vec = CommonSparseFeatures(2).fit(train)
+    assert list(vec.feature_index) == ["a", "b"]
+
+
+def test_batch_vectorization_matches_single():
+    vec = SparseFeatureVectorizer({"x": 0, "y": 1}, dim=2)
+    items = [{"x": 2.0}, {"y": 3.0, "junk": 9.0}, {}]
+    batch = np.asarray(
+        vec.apply_batch(Dataset.from_items(items)).array().todense()
+    )
+    singles = np.stack([_dense(vec.apply(it)) for it in items])
+    np.testing.assert_array_equal(batch, singles)

@@ -1,0 +1,41 @@
+import time, numpy as np, jax.numpy as jnp, sys
+sys.path.insert(0, "/root/repo")
+from keystone_tpu.loaders.csv_loader import LabeledData
+from keystone_tpu.pipelines.text.newsgroups import NewsgroupsConfig, build_pipeline
+from keystone_tpu.parallel.dataset import Dataset
+import keystone_tpu.ops.stats.nodes as sn
+import keystone_tpu.ops.nlp.ngrams as ng
+
+calls = {"tf": 0, "ngram": 0}
+_tf0 = sn.TermFrequency.apply
+def tf_apply(self, terms):
+    calls["tf"] += 1
+    return _tf0(self, terms)
+sn.TermFrequency.apply = tf_apply
+_ng0 = ng.NGramsFeaturizer.apply
+def ng_apply(self, toks):
+    calls["ngram"] += 1
+    return _ng0(self, toks)
+ng.NGramsFeaturizer.apply = ng_apply
+
+rng = np.random.default_rng(0)
+vocab = [f"w{i:04d}" for i in range(2000)]
+docs, ys = [], []
+for i in range(2000):
+    c = i % 20
+    docs.append(" ".join(rng.choice(vocab[c*80:c*80+200], size=60)))
+    ys.append(c)
+train = LabeledData(
+    data=Dataset.from_items(docs),
+    labels=Dataset.from_array(jnp.asarray(np.asarray(ys, np.int32))),
+)
+conf = NewsgroupsConfig(n_grams=2, common_features=10_000)
+
+for rep in range(3):
+    calls["tf"] = calls["ngram"] = 0
+    t0 = time.perf_counter()
+    pipe = build_pipeline(train, conf)
+    preds = pipe.apply(train.data).get()
+    np.asarray(preds.padded()[:1])
+    print(f"rep {rep}: {1e3*(time.perf_counter()-t0):7.1f} ms  "
+          f"tf calls {calls['tf']}  ngram calls {calls['ngram']}", flush=True)

@@ -1,0 +1,38 @@
+import cProfile, pstats, io, time
+import numpy as np, jax.numpy as jnp
+import sys
+sys.path.insert(0, "/root/repo")
+from keystone_tpu.loaders.csv_loader import LabeledData
+from keystone_tpu.pipelines.text.newsgroups import NewsgroupsConfig, build_pipeline
+from keystone_tpu.parallel.dataset import Dataset
+
+rng = np.random.default_rng(0)
+vocab = [f"w{i:04d}" for i in range(2000)]
+docs, ys = [], []
+for i in range(2000):
+    c = i % 20
+    words = rng.choice(vocab[c * 80: c * 80 + 200], size=60)
+    docs.append(" ".join(words))
+    ys.append(c)
+train = LabeledData(
+    data=Dataset.from_items(docs),
+    labels=Dataset.from_array(jnp.asarray(np.asarray(ys, np.int32))),
+)
+conf = NewsgroupsConfig(n_grams=2, common_features=10_000)
+
+def run_once():
+    pipe = build_pipeline(train, conf)
+    preds = pipe.apply(train.data).get()
+    np.asarray(preds.padded()[:1])
+
+run_once()
+t0 = time.perf_counter(); run_once()
+print(f"wall {1e3*(time.perf_counter()-t0):.1f} ms", flush=True)
+
+pr = cProfile.Profile()
+pr.enable()
+run_once()
+pr.disable()
+s = io.StringIO()
+pstats.Stats(pr, stream=s).sort_stats("cumulative").print_stats(28)
+print(s.getvalue())

@@ -1,0 +1,31 @@
+import time, numpy as np, jax.numpy as jnp, sys
+sys.path.insert(0, "/root/repo")
+from keystone_tpu.loaders.csv_loader import LabeledData
+from keystone_tpu.pipelines.text.newsgroups import NewsgroupsConfig, build_pipeline
+from keystone_tpu.parallel.dataset import Dataset
+
+rng = np.random.default_rng(0)
+vocab = [f"w{i:04d}" for i in range(2000)]
+docs, ys = [], []
+for i in range(2000):
+    c = i % 20
+    docs.append(" ".join(rng.choice(vocab[c*80:c*80+200], size=60)))
+    ys.append(c)
+train = LabeledData(
+    data=Dataset.from_items(docs),
+    labels=Dataset.from_array(jnp.asarray(np.asarray(ys, np.int32))),
+)
+conf = NewsgroupsConfig(n_grams=2, common_features=10_000)
+
+for rep in range(3):
+    t0 = time.perf_counter()
+    pipe = build_pipeline(train, conf)
+    t1 = time.perf_counter()
+    res = pipe.apply(train.data)
+    t2 = time.perf_counter()
+    preds = res.get()
+    t3 = time.perf_counter()
+    np.asarray(preds.padded()[:1])
+    t4 = time.perf_counter()
+    print(f"build {1e3*(t1-t0):7.1f}  apply(lazy) {1e3*(t2-t1):6.1f}  "
+          f"get {1e3*(t3-t2):7.1f}  sync {1e3*(t4-t3):6.1f}", flush=True)
