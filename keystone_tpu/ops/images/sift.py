@@ -135,14 +135,32 @@ def _dsift_one_scale(img, *, bin_size: int, step: int, bound_min: int):
     extent = (NUM_SPATIAL_BINS - 1) * bin_size
     nfy = max((H - 1 - bound_min - extent) // step + 1, 0)
     nfx = max((W - 1 - bound_min - extent) // step + 1, 0)
-    fy = bound_min + jnp.arange(nfy) * step
-    fx = bound_min + jnp.arange(nfx) * step
-    bins = jnp.arange(NUM_SPATIAL_BINS) * bin_size
-    ys = fy[:, None] + bins[None, :]  # (nfy, 4)
-    xs = fx[:, None] + bins[None, :]  # (nfx, 4)
-    # gather: desc[f_y, f_x, j, i, t] = smoothed[t, ys[f_y, j], xs[f_x, i]]
-    g = smoothed[:, ys][:, :, :, xs]  # (8, nfy, 4, nfx, 4)
-    g = jnp.transpose(g, (1, 3, 2, 4, 0))  # (nfy, nfx, j, i, t)
+    if nfy == 0 or nfx == 0:
+        return (
+            jnp.zeros((0, DESCRIPTOR_DIMS), jnp.float32),
+            jnp.zeros((0,), jnp.float32),
+        )
+    # desc[f_y, f_x, j, i, t] = smoothed[t, bound + f_y·step + j·bin,
+    #                                       bound + f_x·step + i·bin].
+    # The index set is affine in (f, binidx), so STRIDED SLICES express
+    # it exactly — advanced-index gathers here cost ~75 ms/128-img batch
+    # on the v5e (measured), the slices ~0
+    def bin_slices(x, axis, nf):
+        parts = [
+            jax.lax.slice_in_dim(
+                x,
+                bound_min + j * bin_size,
+                bound_min + j * bin_size + (nf - 1) * step + 1,
+                stride=step,
+                axis=axis,
+            )
+            for j in range(NUM_SPATIAL_BINS)
+        ]
+        return jnp.stack(parts, axis=axis)
+
+    g = bin_slices(smoothed, 1, nfy)  # (8, j, nfy, W)
+    g = bin_slices(g, 3, nfx)         # (8, j, nfy, i, nfx)
+    g = jnp.transpose(g, (2, 4, 1, 3, 0))  # (nfy, nfx, j, i, t)
     wf = jnp.asarray(_window_factors(bin_size))
     g = g * wf[None, None, :, None, None] * wf[None, None, None, :, None]
     raw = g.reshape(-1, DESCRIPTOR_DIMS)

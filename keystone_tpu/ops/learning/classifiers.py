@@ -62,7 +62,10 @@ class NaiveBayesEstimator(LabelEstimator):
         # whole fit stays in the dispatch stream: pulling the labels to
         # the host costs a full tunnel round-trip (~100 ms) on remote
         # devices and forces the async pipeline to drain
-        y = jnp.asarray(labels.array()).reshape(-1)
+        # int cast keeps the old np.eye semantics for float labels
+        # (1.5 trains as 1); the range guard below then sees the same
+        # values one_hot does
+        y = jnp.asarray(labels.array()).reshape(-1).astype(jnp.int32)
         x = data.padded()
         onehot = jax.nn.one_hot(y, self.num_classes, dtype=jnp.float32)
         # one_hot maps out-of-range labels to a zero row, which would

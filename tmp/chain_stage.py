@@ -1,0 +1,61 @@
+import time, sys, numpy as np, jax, jax.numpy as jnp
+sys.path.insert(0, "/root/repo")
+import bench
+from keystone_tpu.ops.images.fisher_vector import FisherVector
+from keystone_tpu.ops.images.lcs import LCSExtractor
+from keystone_tpu.ops.images.sift import SIFTExtractor
+from keystone_tpu.ops.images.core import GrayScaler, PixelScaler
+from keystone_tpu.ops.learning import BatchPCATransformer
+from keystone_tpu.ops.learning.gmm import GaussianMixtureModel
+from keystone_tpu.ops.stats import NormalizeRows, SignedHellingerMapper
+from keystone_tpu.workflow.api import Pipeline
+
+rng = np.random.default_rng(0)
+imgs = bench._fixture_images(128, 256)
+X = jnp.asarray(imgs)
+
+def force(a):
+    np.asarray(jax.tree_util.tree_leaves(a)[0].ravel()[:1])
+
+def timeit(name, fn, *args, reps=3):
+    force(fn(*args))
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        outs = [fn(*args) for _ in range(4)]
+        for o in outs: force(o)
+        best = min(best, (time.perf_counter() - t0) / 4)
+    print(f"{name:44s} {best*1e3:9.2f} ms/batch ({128/best:7.1f} ex/s)", flush=True)
+
+desc_dim, vocab = 64, 16
+pca = jnp.asarray(rng.standard_normal((desc_dim, 128)).astype(np.float32) * 0.1)
+gmm = GaussianMixtureModel(
+    jnp.asarray(rng.standard_normal((desc_dim, vocab)), jnp.float32),
+    jnp.ones((desc_dim, vocab), jnp.float32),
+    jnp.ones((vocab,), jnp.float32) / vocab,
+)
+
+# 1. sift branch through hellinger (pre-PCA)
+p1 = (PixelScaler().and_then(GrayScaler())
+      .and_then(SIFTExtractor(scale_step=1))
+      .and_then(SignedHellingerMapper())).fit().jit_batch()
+timeit("sift + hellinger", p1, X)
+
+# 2. + PCA
+p2 = (PixelScaler().and_then(GrayScaler())
+      .and_then(SIFTExtractor(scale_step=1))
+      .and_then(SignedHellingerMapper())
+      .and_then(BatchPCATransformer(pca.T))).fit().jit_batch()
+timeit("+ batch PCA", p2, X)
+
+# 3. + FV
+p3 = (PixelScaler().and_then(GrayScaler())
+      .and_then(SIFTExtractor(scale_step=1))
+      .and_then(SignedHellingerMapper())
+      .and_then(BatchPCATransformer(pca.T))
+      .and_then(FisherVector(gmm))).fit().jit_batch()
+timeit("+ fisher vector", p3, X)
+
+# 4. full (both branches)
+full = bench._build_fv_pipeline(rng, desc_dim, vocab).fit().jit_batch()
+timeit("full two-branch chain", full, X)

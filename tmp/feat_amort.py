@@ -1,0 +1,19 @@
+import time, sys, numpy as np, jax, jax.numpy as jnp
+sys.path.insert(0, "/root/repo")
+import bench
+
+rng = np.random.default_rng(0)
+imgs = bench._fixture_images(128, 256)
+X = jnp.asarray(imgs)
+full = bench._build_fv_pipeline(rng, 64, 16).fit().jit_batch()
+
+def force(a):
+    np.asarray(jax.tree_util.tree_leaves(a)[0].ravel()[:1])
+
+force(full(X))
+for rep in range(4):
+    t0 = time.perf_counter()
+    outs = [full(X) for _ in range(8)]
+    for o in outs: force(o)
+    dt = time.perf_counter() - t0
+    print(f"8x128 imgs: {dt*1e3:8.1f} ms  -> {8*128/dt:7.1f} ex/s", flush=True)

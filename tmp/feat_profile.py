@@ -1,0 +1,47 @@
+"""Stage breakdown of the flagship featurize on the real chip."""
+import time, sys, numpy as np, jax, jax.numpy as jnp
+sys.path.insert(0, "/root/repo")
+import bench
+
+rng = np.random.default_rng(0)
+imgs = bench._fixture_images(128, 256)
+X = jnp.asarray(imgs)
+print("batch", X.shape, X.dtype, flush=True)
+
+def force(a):
+    np.asarray(jax.tree_util.tree_leaves(a)[0].ravel()[:1])
+
+def timeit(name, fn, *args, reps=3):
+    force(fn(*args))
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter(); force(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    print(f"{name:34s} {best*1e3:9.2f} ms  ({128/best:7.1f} ex/s)", flush=True)
+    return best
+
+@jax.jit
+def rt(s): return s + 1.0
+force(rt(jnp.float32(1.0)))
+t0=time.perf_counter(); force(rt(jnp.float32(2.0)))
+print(f"RT {1e3*(time.perf_counter()-t0):.1f} ms", flush=True)
+
+# full chain
+full = bench._build_fv_pipeline(rng, 64, 16).fit().jit_batch()
+timeit("full SIFT+LCS+FV chain", full, X)
+
+# SIFT branch alone (gray + sift + hellinger)
+from keystone_tpu.ops.images.sift import SIFTExtractor
+from keystone_tpu.ops.images.lcs import LCSExtractor
+from keystone_tpu.ops.images.core import GrayScaler, PixelScaler
+from keystone_tpu.ops.stats import SignedHellingerMapper
+from keystone_tpu.workflow.api import Pipeline
+
+sift_only = (PixelScaler().and_then(GrayScaler())
+             .and_then(SIFTExtractor(scale_step=1))).fit().jit_batch()
+timeit("SIFT extract only", sift_only, X)
+
+lcs_only = LCSExtractor(4, 16, 6).to_pipeline().fit().jit_batch()
+timeit("LCS extract only", lcs_only, X)
+
+full_sift_branch = bench._build_fv_pipeline(rng, 64, 16)  # rebuild for fresh rng state parity not needed

@@ -1,0 +1,87 @@
+import time, sys, numpy as np, jax, jax.numpy as jnp
+sys.path.insert(0, "/root/repo")
+from keystone_tpu.ops.images.sift import (
+    _sep_conv2d, _gaussian_kernel, _triangular_kernel, SIFTExtractor,
+    MAGNIF, NUM_ORIENTATIONS,
+)
+
+B, H, W = 128, 256, 256
+imgs = jnp.asarray(np.random.default_rng(0).random((B, H, W), np.float32))
+
+def force(a):
+    np.asarray(jax.tree_util.tree_leaves(a)[0].ravel()[:1])
+
+def timeit(name, fn, *args, reps=3):
+    force(fn(*args))
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter(); force(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    print(f"{name:40s} {best*1e3:9.2f} ms", flush=True)
+
+@jax.jit
+def rt(s): return s + 1.0
+force(rt(jnp.float32(1.0)))
+t0=time.perf_counter(); force(rt(jnp.float32(2.0)))
+print(f"RT {1e3*(time.perf_counter()-t0):.1f} ms", flush=True)
+
+# stage A: the 4 gaussian pre-smooths (one per scale) on all images
+@jax.jit
+def stage_smooth(x):
+    acc = jnp.float32(0)
+    for scale in range(4):
+        bin_size = 4 + 2 * scale
+        k = _gaussian_kernel(bin_size / MAGNIF)
+        sm = _sep_conv2d(x, k, edge_pad=True)
+        acc = acc + sm.sum()
+    return acc
+timeit("4x gaussian smooth (sep conv)", stage_smooth, imgs)
+
+# stage B: + gradient/one-hot planes
+@jax.jit
+def stage_planes(x):
+    acc = jnp.float32(0)
+    for scale in range(4):
+        bin_size = 4 + 2 * scale
+        k = _gaussian_kernel(bin_size / MAGNIF)
+        sm = _sep_conv2d(x, k, edge_pad=True)
+        gy, gx = jnp.gradient(sm, axis=(1, 2))
+        mag = jnp.sqrt(gx*gx + gy*gy)
+        ang = jnp.arctan2(gy, gx) % (2.0*jnp.pi)
+        t = ang / (2.0*jnp.pi) * 8
+        b0 = jnp.floor(t); frac = t - b0
+        b0 = b0.astype(jnp.int32) % 8
+        b1 = (b0 + 1) % 8
+        planes = (jax.nn.one_hot(b0, 8, axis=1) * (mag*(1-frac))[:, None]
+                  + jax.nn.one_hot(b1, 8, axis=1) * (mag*frac)[:, None])
+        acc = acc + planes.sum()
+    return acc
+timeit("+ gradients/one-hot planes", stage_planes, imgs)
+
+# stage C: + triangular conv on the 8-plane stacks
+@jax.jit
+def stage_tri(x):
+    acc = jnp.float32(0)
+    for scale in range(4):
+        bin_size = 4 + 2 * scale
+        k = _gaussian_kernel(bin_size / MAGNIF)
+        sm = _sep_conv2d(x, k, edge_pad=True)
+        gy, gx = jnp.gradient(sm, axis=(1, 2))
+        mag = jnp.sqrt(gx*gx + gy*gy)
+        ang = jnp.arctan2(gy, gx) % (2.0*jnp.pi)
+        t = ang / (2.0*jnp.pi) * 8
+        b0 = jnp.floor(t); frac = t - b0
+        b0 = b0.astype(jnp.int32) % 8
+        b1 = (b0 + 1) % 8
+        planes = (jax.nn.one_hot(b0, 8, axis=1) * (mag*(1-frac))[:, None]
+                  + jax.nn.one_hot(b1, 8, axis=1) * (mag*frac)[:, None])
+        planes = planes.reshape(-1, H, W)
+        smoothed = _sep_conv2d(planes, _triangular_kernel(bin_size))
+        acc = acc + smoothed.sum()
+    return acc
+timeit("+ triangular sep conv (8 planes)", stage_tri, imgs)
+
+# full SIFT via bucketed vmap (as jit_batch does)
+ext = SIFTExtractor(scale_step=1)
+vf = jax.jit(jax.vmap(ext.apply))
+timeit("full SIFT (vmap apply)", vf, imgs)
