@@ -25,8 +25,17 @@ asserted here; the algorithm is validated against an independent numpy
 translation of the same spec (tests/ops/test_sift_fv.py).
 
 TPU mapping: everything is fused XLA — gradients, one-hot orientation
-scatter, two separable triangular convs (depthwise conv on the 8-plane
-stack), strided gather of bin centers. Static shapes per (W, H, scale).
+scatter, and the whole spatial-binning stage (triangular convolution +
+bin-center sampling + Gaussian window factors) folded into two small
+per-scale SAMPLING MATRICES applied as MXU GEMMs. The stage is linear
+in the orientation planes and separable per axis, so
+``A[y, f·4+j] = tri(y − (bound + f·step + j·bin)) · wf[j]`` expresses
+tri-conv→sample→window exactly; measured ~5× over the
+conv→strided-slice formulation on the v5e (SIFT device time ~110 →
+~22 ms per 128×256² batch; the C=1 depthwise convs ran on the VPU and
+the slicing materialized awkwardly-tiled intermediates), lifting the
+flagship featurize row from 889 to 1806 ex/s/chip (PERF_r05.md).
+Static shapes per (W, H, scale).
 """
 
 from __future__ import annotations
@@ -60,19 +69,12 @@ def _gaussian_kernel(sigma: float) -> np.ndarray:
     return (k / k.sum()).astype(np.float32)
 
 
-def _triangular_kernel(bin_size: int) -> np.ndarray:
-    """Bilinear spatial-binning kernel (vl_imconvcoltri): tri(i) =
-    (binSize − |i|)/binSize for |i| < binSize."""
-    xs = np.arange(-(bin_size - 1), bin_size)
-    return ((bin_size - np.abs(xs)) / bin_size).astype(np.float32)
-
-
-def _sep_conv2d(
-    planes: jnp.ndarray, k: np.ndarray, edge_pad: bool = False
-) -> jnp.ndarray:
-    """Separable same-size conv of (P, H, W) planes with a 1-D kernel.
-    ``edge_pad=True`` replicates borders (vl_imsmooth's continuity
-    padding); False zero-pads (the orientation-plane binning case)."""
+def _sep_conv2d(planes: jnp.ndarray, k: np.ndarray) -> jnp.ndarray:
+    """Separable same-size conv of (P, H, W) planes with a 1-D kernel,
+    borders replicated (vl_imsmooth's continuity padding). Only the
+    Gaussian pre-smooth comes through here — the triangular spatial
+    binning is folded into the sampling-matrix GEMMs
+    (_sampling_matrix)."""
     kj = jnp.asarray(k)
     pad = (len(k) - 1) // 2
 
@@ -80,15 +82,12 @@ def _sep_conv2d(
         moved = jnp.moveaxis(x, axis, -1)
         shape = moved.shape
         flat = moved.reshape(-1, 1, shape[-1])
-        if edge_pad and pad > 0:
+        if pad > 0:
             flat = jnp.pad(
                 flat, ((0, 0), (0, 0), (pad, pad)), mode="edge"
             )
-            pads = [(0, 0)]
-        else:
-            pads = [(pad, pad)]
         out = jax.lax.conv_general_dilated(
-            flat, kj[None, None, :], (1,), pads,
+            flat, kj[None, None, :], (1,), [(0, 0)],
             dimension_numbers=("NCH", "OIH", "NCH"),
         )
         return jnp.moveaxis(
@@ -107,6 +106,29 @@ def _window_factors(bin_size: int) -> np.ndarray:
     ) * bin_size
     sigma = WINDOW_SIZE * bin_size
     return np.exp(-0.5 * (centers / sigma) ** 2).astype(np.float32)
+
+
+def _sampling_matrix(
+    n: int, nf: int, bin_size: int, step: int, bound: int
+) -> np.ndarray:
+    """(n, nf·4) one-axis spatial-binning operator: column f·4+j holds
+    the triangular kernel tri(d) = max(0, (bin−|d|)/bin) centered at
+    bound + f·step + j·bin (zero outside the image — vl_imconvcoltri's
+    zero padding), pre-scaled by the Gaussian window factor wf[j].
+    Applying it on each axis reproduces triangular conv → bin-center
+    sample → window EXACTLY (the stage is linear and separable), as two
+    MXU GEMMs instead of VPU-bound C=1 convs plus slicing. Built per
+    trace — jit's per-static-shape caching makes memoization redundant,
+    and the build is nf·4 tiny numpy rows."""
+    wf = _window_factors(bin_size)
+    m = np.zeros((n, nf * NUM_SPATIAL_BINS), np.float32)
+    ys = np.arange(n)
+    for f in range(nf):
+        for j in range(NUM_SPATIAL_BINS):
+            c = bound + f * step + j * bin_size
+            tri = np.maximum(0.0, (bin_size - np.abs(ys - c)) / bin_size)
+            m[:, f * NUM_SPATIAL_BINS + j] = tri * wf[j]
+    return m
 
 
 @partial(jax.jit, static_argnames=("bin_size", "step", "bound_min"))
@@ -130,7 +152,6 @@ def _dsift_one_scale(img, *, bin_size: int, step: int, bound_min: int):
         jax.nn.one_hot(b0, NUM_ORIENTATIONS, axis=0) * (mag * (1 - frac))
         + jax.nn.one_hot(b1, NUM_ORIENTATIONS, axis=0) * (mag * frac)
     )  # (8, H, W)
-    smoothed = _sep_conv2d(planes, _triangular_kernel(bin_size))
 
     extent = (NUM_SPATIAL_BINS - 1) * bin_size
     nfy = max((H - 1 - bound_min - extent) // step + 1, 0)
@@ -140,29 +161,17 @@ def _dsift_one_scale(img, *, bin_size: int, step: int, bound_min: int):
             jnp.zeros((0, DESCRIPTOR_DIMS), jnp.float32),
             jnp.zeros((0,), jnp.float32),
         )
-    # desc[f_y, f_x, j, i, t] = smoothed[t, bound + f_y·step + j·bin,
-    #                                       bound + f_x·step + i·bin].
-    # The index set is affine in (f, binidx), so STRIDED SLICES express
-    # it exactly — advanced-index gathers here cost ~75 ms/128-img batch
-    # on the v5e (measured), the slices ~0
-    def bin_slices(x, axis, nf):
-        parts = [
-            jax.lax.slice_in_dim(
-                x,
-                bound_min + j * bin_size,
-                bound_min + j * bin_size + (nf - 1) * step + 1,
-                stride=step,
-                axis=axis,
-            )
-            for j in range(NUM_SPATIAL_BINS)
-        ]
-        return jnp.stack(parts, axis=axis)
-
-    g = bin_slices(smoothed, 1, nfy)  # (8, j, nfy, W)
-    g = bin_slices(g, 3, nfx)         # (8, j, nfy, i, nfx)
-    g = jnp.transpose(g, (2, 4, 1, 3, 0))  # (nfy, nfx, j, i, t)
-    wf = jnp.asarray(_window_factors(bin_size))
-    g = g * wf[None, None, :, None, None] * wf[None, None, None, :, None]
+    # the whole tri-conv → bin-sample → window stage as two GEMMs (see
+    # _sampling_matrix); f32 HIGHEST keeps full conv accuracy
+    Ay = jnp.asarray(_sampling_matrix(H, nfy, bin_size, step, bound_min))
+    Ax = jnp.asarray(_sampling_matrix(W, nfx, bin_size, step, bound_min))
+    hp = jax.lax.Precision.HIGHEST
+    t1 = jnp.einsum("thw,hm->tmw", planes, Ay, precision=hp)
+    g = jnp.einsum("tmw,wn->tmn", t1, Ax, precision=hp)
+    g = g.reshape(
+        NUM_ORIENTATIONS, nfy, NUM_SPATIAL_BINS, nfx, NUM_SPATIAL_BINS
+    )
+    g = jnp.transpose(g, (1, 3, 2, 4, 0))  # (nfy, nfx, j, i, t)
     raw = g.reshape(-1, DESCRIPTOR_DIMS)
     norms = jnp.linalg.norm(raw, axis=1)
     desc = raw / jnp.maximum(norms, 1e-12)[:, None]
@@ -195,7 +204,7 @@ class SIFTExtractor(Transformer):
             bin_size = self.bin + 2 * scale
             sigma = bin_size / MAGNIF
             k = _gaussian_kernel(sigma)
-            sm = _sep_conv2d(x[None], k, edge_pad=True)[0]
+            sm = _sep_conv2d(x[None], k)[0]
             bound = (1 + 2 * self.num_scales) - 3 * scale
             desc, norms = _dsift_one_scale(
                 sm,

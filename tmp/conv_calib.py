@@ -1,0 +1,50 @@
+import time, sys, numpy as np, jax, jax.numpy as jnp
+sys.path.insert(0, "/root/repo")
+
+P, H, W = 1024, 256, 256
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.random((P, H, W), np.float32))
+Bm = jnp.asarray(rng.random((256, 256), np.float32))
+
+def force(a):
+    np.asarray(jax.tree_util.tree_leaves(a)[0].ravel()[:1])
+
+def timeit(name, fn, *args, reps=3):
+    force(fn(*args))
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        outs = [fn(*args) for _ in range(4)]
+        for o in outs: force(o)
+        best = min(best, (time.perf_counter() - t0) / 4)
+    print(f"{name:44s} {best*1e3:9.2f} ms/call", flush=True)
+
+hp = jax.lax.Precision.HIGHEST
+
+@jax.jit
+def one_gemm(x, Bm):
+    return jnp.matmul(x, Bm, precision=hp).sum()
+timeit("1x (1024*256,256)@(256,256) HIGHEST", one_gemm, x, Bm)
+
+@jax.jit
+def one_gemm_x3(x, Bm):
+    y = jax.lax.dot_general(x, Bm, (((2,), (0,)), ((), ())),
+        precision=jax.lax.DotAlgorithmPreset.BF16_BF16_F32_X3)
+    return y.sum()
+timeit("1x same GEMM X3", one_gemm_x3, x, Bm)
+
+@jax.jit
+def eight_gemm(x, Bm):
+    acc = jnp.float32(0)
+    for _ in range(8):
+        acc = acc + jnp.matmul(x, Bm, precision=hp).sum()
+    return acc
+timeit("8x same GEMM HIGHEST", eight_gemm, x, Bm)
+
+@jax.jit
+def sep_both_axes(x, Bm):
+    y = jnp.matmul(x, Bm, precision=hp)          # along W
+    yt = jnp.swapaxes(y, 1, 2)
+    z = jnp.matmul(yt, Bm, precision=hp)         # along H
+    return jnp.swapaxes(z, 1, 2).sum()
+timeit("sep conv via 2 GEMM + 2 transpose", sep_both_axes, x, Bm)

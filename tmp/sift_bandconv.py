@@ -1,0 +1,99 @@
+"""Banded-GEMM separable conv vs conv_general_dilated for SIFT."""
+import time, sys, numpy as np, jax, jax.numpy as jnp
+from functools import partial
+sys.path.insert(0, "/root/repo")
+from keystone_tpu.ops.images.sift import (
+    SIFTExtractor, _sep_conv2d, _gaussian_kernel, _triangular_kernel,
+    _window_factors, MAGNIF, CONTRAST_THRESHOLD,
+)
+
+B, H, W = 128, 256, 256
+rng = np.random.default_rng(0)
+imgs = jnp.asarray(rng.random((B, H, W), np.float32))
+
+def force(a):
+    np.asarray(jax.tree_util.tree_leaves(a)[0].ravel()[:1])
+
+def timeit(name, fn, *args, reps=3):
+    force(fn(*args))
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        outs = [fn(*args) for _ in range(4)]
+        for o in outs: force(o)
+        best = min(best, (time.perf_counter() - t0) / 4)
+    print(f"{name:36s} {best*1e3:9.2f} ms/batch", flush=True)
+
+def band_matrix(k, n, edge_pad):
+    """(n, n) such that (x @ Bm)[i] = sum_d k[d] x[i + d - pad] with
+    zero or edge padding."""
+    pad = (len(k) - 1) // 2
+    Bm = np.zeros((n, n), np.float32)
+    for i in range(n):
+        for d, kv in enumerate(k):
+            j = i + d - pad
+            if 0 <= j < n:
+                Bm[j, i] += kv
+            elif edge_pad:
+                Bm[min(max(j, 0), n - 1), i] += kv
+    return Bm
+
+_BANDS = {}
+def get_band(key, k, n, edge_pad):
+    if key not in _BANDS:
+        _BANDS[key] = jnp.asarray(band_matrix(k, n, edge_pad))
+    return _BANDS[key]
+
+def sep_conv_gemm(planes, k, edge_pad=False):
+    """(P, H, W) -> same-size separable conv via two banded GEMMs."""
+    P, Hh, Ww = planes.shape
+    hp = jax.lax.Precision.HIGHEST
+    Bw = get_band(("w", len(k), float(k[0]), Ww, edge_pad), k, Ww, edge_pad)
+    Bh = get_band(("h", len(k), float(k[0]), Hh, edge_pad), k, Hh, edge_pad)
+    x = jnp.matmul(planes, Bw, precision=hp)           # conv along W
+    x = jnp.matmul(Bh.T, x.reshape(P, Hh, Ww).transpose(0, 2, 1) if False else x.transpose(0, 2, 1), precision=hp)
+    return x.transpose(0, 2, 1) if False else jnp.matmul(
+        planes * 0, planes * 0, precision=hp)  # placeholder (unused)
+
+# simpler: x conv along W: (P,H,W)@(W,W); along H: einsum hj,pjw->phw
+def sep_conv_gemm2(planes, k, edge_pad=False):
+    P, Hh, Ww = planes.shape
+    hp = jax.lax.Precision.HIGHEST
+    Bw = get_band(("w", tuple(np.round(k, 9)), Ww, edge_pad), k, Ww, edge_pad)
+    Bh = get_band(("h", tuple(np.round(k, 9)), Hh, edge_pad), k, Hh, edge_pad)
+    x = jnp.matmul(planes, Bw, precision=hp)
+    x = jnp.einsum("hj,pjw->phw", Bh.T, x, precision=hp)
+    return x
+
+# parity check vs _sep_conv2d
+pl = jnp.asarray(rng.random((8, H, W), np.float32))
+for bs, ep in [(7, False), (11, False)]:
+    k = _triangular_kernel((bs + 1) // 2)
+    a = np.asarray(_sep_conv2d(pl, k, edge_pad=ep))
+    b = np.asarray(sep_conv_gemm2(pl, k, edge_pad=ep))
+    print(f"tri k={len(k)} edge={ep}: max diff {np.abs(a-b).max():.2e}",
+          flush=True)
+kg = _gaussian_kernel(4 / MAGNIF)
+a = np.asarray(_sep_conv2d(pl, kg, edge_pad=True))
+b = np.asarray(sep_conv_gemm2(pl, kg, edge_pad=True))
+print(f"gauss edge=True: max diff {np.abs(a-b).max():.2e}", flush=True)
+
+# timing: all 4 scales of tri conv on (8B, H, W)
+big = jnp.asarray(rng.random((8 * B, H, W), np.float32))
+
+@jax.jit
+def tri_conv_cur(x):
+    acc = jnp.float32(0)
+    for scale in range(4):
+        acc = acc + _sep_conv2d(x, _triangular_kernel(4 + 2*scale)).sum()
+    return acc
+
+@jax.jit
+def tri_conv_gemm(x):
+    acc = jnp.float32(0)
+    for scale in range(4):
+        acc = acc + sep_conv_gemm2(x, _triangular_kernel(4 + 2*scale)).sum()
+    return acc
+
+timeit("4x tri conv (conv_general)", tri_conv_cur, big)
+timeit("4x tri conv (banded GEMM)", tri_conv_gemm, big)

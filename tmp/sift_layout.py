@@ -1,0 +1,86 @@
+"""Layout experiment: normalize in (8,4,nfy,4,nfx) layout, relayout once."""
+import time, sys, numpy as np, jax, jax.numpy as jnp
+from functools import partial
+sys.path.insert(0, "/root/repo")
+from keystone_tpu.ops.images.sift import (
+    SIFTExtractor, _sep_conv2d, _gaussian_kernel, _triangular_kernel,
+    _window_factors, _dsift_one_scale, MAGNIF, CONTRAST_THRESHOLD,
+    NUM_SPATIAL_BINS, DESCRIPTOR_DIMS,
+)
+
+B, H, W = 128, 256, 256
+rng = np.random.default_rng(0)
+imgs = jnp.asarray(rng.random((B, H, W), np.float32))
+
+def force(a):
+    np.asarray(jax.tree_util.tree_leaves(a)[0].ravel()[:1])
+
+def timeit(name, fn, *args, reps=3):
+    force(fn(*args))
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        outs = [fn(*args) for _ in range(4)]
+        for o in outs: force(o)
+        best = min(best, (time.perf_counter() - t0) / 4)
+    print(f"{name:36s} {best*1e3:9.2f} ms/batch", flush=True)
+
+@partial(jax.jit, static_argnames=("bin_size", "step", "bound_min"))
+def _dsift_alt(img, *, bin_size, step, bound_min):
+    Hh, Ww = img.shape
+    gy, gx = jnp.gradient(img)
+    mag = jnp.sqrt(gx*gx + gy*gy)
+    ang = jnp.arctan2(gy, gx) % (2.0*jnp.pi)
+    t = ang / (2.0*jnp.pi) * 8
+    b0 = jnp.floor(t); frac = t - b0
+    b0 = b0.astype(jnp.int32) % 8
+    b1 = (b0 + 1) % 8
+    planes = (jax.nn.one_hot(b0, 8, axis=0) * (mag*(1-frac))
+              + jax.nn.one_hot(b1, 8, axis=0) * (mag*frac))
+    smoothed = _sep_conv2d(planes, _triangular_kernel(bin_size))
+    extent = 3*bin_size
+    nfy = max((Hh - 1 - bound_min - extent)//step + 1, 0)
+    nfx = max((Ww - 1 - bound_min - extent)//step + 1, 0)
+    def bin_slices(x, axis, nf):
+        parts = [jax.lax.slice_in_dim(
+            x, bound_min + j*bin_size,
+            bound_min + j*bin_size + (nf-1)*step + 1,
+            stride=step, axis=axis) for j in range(4)]
+        return jnp.stack(parts, axis=axis)
+    g = bin_slices(smoothed, 1, nfy)   # (8, j, nfy, W)
+    g = bin_slices(g, 3, nfx)          # (8, j, nfy, i, nfx)
+    wf = jnp.asarray(_window_factors(bin_size))
+    g = g * wf[None, :, None, None, None] * wf[None, None, None, :, None]
+    # all math in this layout; reduce over (t, j, i) -> (nfy, nfx)
+    norms = jnp.sqrt(jnp.sum(g*g, axis=(0, 1, 3)))
+    g = g / jnp.maximum(norms, 1e-12)[None, None, :, None, :]
+    g = jnp.minimum(g, 0.2)
+    n2 = jnp.sqrt(jnp.sum(g*g, axis=(0, 1, 3)))
+    g = g / jnp.maximum(n2, 1e-12)[None, None, :, None, :]
+    g = jnp.where((norms >= CONTRAST_THRESHOLD)[None, None, :, None, :],
+                  g, 0.0)
+    g = jnp.minimum(jnp.floor(g * 512.0), 255.0)
+    # one relayout at the end: (t,j,fy,i,fx) -> (fy,fx,j,i,t) flat
+    out = jnp.transpose(g, (2, 4, 1, 3, 0)).reshape(-1, 128)
+    return out, norms.reshape(-1)
+
+def apply_alt(img):
+    x = img
+    descs = []
+    for scale in range(4):
+        bin_size = 4 + 2*scale
+        k = _gaussian_kernel(bin_size / MAGNIF)
+        sm = _sep_conv2d(x[None], k, edge_pad=True)[0]
+        bound = 9 - 3*scale
+        d, _ = _dsift_alt(sm, bin_size=bin_size, step=3+scale, bound_min=bound)
+        descs.append(d)
+    return jnp.concatenate(descs, axis=0).T
+
+ext = SIFTExtractor(scale_step=1)
+cur = jax.jit(jax.vmap(ext.apply))
+alt = jax.jit(jax.vmap(apply_alt))
+timeit("current SIFT", cur, imgs)
+timeit("alt layout SIFT", alt, imgs)
+a = np.asarray(cur(imgs[:2]))
+b = np.asarray(alt(imgs[:2]))
+print("parity max diff:", np.abs(a - b).max(), flush=True)
