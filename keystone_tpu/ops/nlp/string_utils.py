@@ -15,13 +15,24 @@ from keystone_tpu.workflow.api import Transformer
 @dataclasses.dataclass(eq=False)
 class Tokenizer(Transformer):
     """Split on a delimiting regex (default: punctuation + whitespace,
-    matching the reference's ``[\\p{Punct}\\s]+``)."""
+    matching the reference's ``[\\p{Punct}\\s]+``).
+
+    Scala ``String.split`` semantics are reproduced exactly
+    (StringUtilsSuite "tokenizer"): a string that STARTS with a
+    separator yields a leading empty token (which the reference's
+    downstream TF/vocab nodes then count as a term), trailing empty
+    tokens are removed, and the no-match case returns the original
+    string whole — so ``""`` tokenizes to ``[""]``, Java's documented
+    quirk."""
 
     sep: str = r"[^\w]+"
     vmap_batch = False
 
     def apply(self, s: str):
-        return [t for t in re.split(self.sep, s) if t]
+        parts = re.split(self.sep, s)
+        while len(parts) > 1 and parts[-1] == "":
+            parts.pop()
+        return parts
 
     def eq_key(self):
         return ("tokenizer", self.sep)

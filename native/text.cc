@@ -42,8 +42,16 @@ void process_doc(const char* begin, const char* end, int min_order,
   while (begin < end && static_cast<unsigned char>(*begin) <= ' ') ++begin;
   while (end > begin && static_cast<unsigned char>(end[-1]) <= ' ') --end;
 
-  // tokenize + per-token FNV-1a over lowercased bytes
+  // tokenize + per-token FNV-1a over lowercased bytes. Java/Scala
+  // String.split semantics (mirrored by the Python Tokenizer): a doc
+  // that starts with a separator yields a leading EMPTY token, and an
+  // empty doc tokenizes to [""] — both hash to the bare FNV offset
+  // (stable_hash("")).
   std::vector<uint32_t> token_hashes;
+  if (begin >= end ||
+      !is_word_byte(static_cast<unsigned char>(*begin))) {
+    token_hashes.push_back(kFnvOffset);
+  }
   const char* p = begin;
   while (p < end) {
     while (p < end && !is_word_byte(static_cast<unsigned char>(*p))) ++p;

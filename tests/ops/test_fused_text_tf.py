@@ -17,6 +17,12 @@ DOCS = [
     "",
     "a b a b a  --  punct,punct;punct",
     "Numbers 123 and under_scores mix_9 OK",
+    # Scala-split leading-empty-token cases: a doc that starts with a
+    # separator AFTER trim emits a "" token, and a punctuation-only doc
+    # tokenizes to [""] — the native path must hash identically
+    "!great product",
+    "  !! leading punct after trim",
+    "?!?",
 ]
 
 

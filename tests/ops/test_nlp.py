@@ -27,6 +27,30 @@ def test_string_utils():
     assert Tokenizer().apply("Hello, world! foo") == ["Hello", "world", "foo"]
 
 
+def test_string_utils_reference_suite_fixtures():
+    """Port of StringUtilsSuite (nodes/nlp/StringUtilsSuite.scala) with
+    its exact fixtures — including Scala split semantics: leading empty
+    token kept, trailing empties dropped."""
+    strings = [
+        "  The quick BROWN fo.X ",
+        " ! !.,)JumpeD. ovER the LAZy DOG.. ! ",
+    ]
+    assert [Trim().apply(s) for s in strings] == [
+        "The quick BROWN fo.X",
+        "! !.,)JumpeD. ovER the LAZy DOG.. !",
+    ]
+    assert [LowerCase().apply(s) for s in strings] == [
+        "  the quick brown fo.x ",
+        " ! !.,)jumped. over the lazy dog.. ! ",
+    ]
+    assert [Tokenizer().apply(s) for s in strings] == [
+        ["", "The", "quick", "BROWN", "fo", "X"],
+        ["", "JumpeD", "ovER", "the", "LAZy", "DOG"],
+    ]
+
+
+
+
 def test_ngrams_featurizer_orders_and_content():
     grams = NGramsFeaturizer([1, 2, 3]).apply(["a", "b", "c"])
     assert ["a"] in grams and ["a", "b"] in grams and ["a", "b", "c"] in grams

@@ -15,8 +15,30 @@ from keystone_tpu.ops.stats import (
     Sampler,
     SignedHellingerMapper,
     StandardScaler,
+    TermFrequency,
 )
 from keystone_tpu.parallel.dataset import Dataset
+
+
+def test_term_frequency_reference_suite_fixtures():
+    """Port of TermFrequencySuite (nodes/misc/TermFrequencySuite.scala):
+    simple strings, mixed hashable types (ngram tuples + ints), and the
+    log-weighted variant."""
+    import math
+
+    out = TermFrequency().apply(["b", "a", "c", "b", "b", "a", "b"])
+    assert out == {"a": 2, "b": 4, "c": 1}
+
+    mixed = ["b", "a", "c", ("b", "b"), ("b", "b"), 12, 12, "a", "b", 12]
+    out = TermFrequency().apply(mixed)
+    assert out == {"a": 2, "b": 2, "c": 1, ("b", "b"): 2, 12: 3}
+
+    out = TermFrequency(lambda x: math.log(x + 1)).apply(
+        ["b", "a", "c", "b", "b", "a", "b"]
+    )
+    assert out == {
+        "a": math.log(3), "b": math.log(5), "c": math.log(2),
+    }
 
 
 def test_random_sign_node_involution():

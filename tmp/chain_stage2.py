@@ -1,0 +1,52 @@
+import time, sys, numpy as np, jax, jax.numpy as jnp
+sys.path.insert(0, "/root/repo")
+import bench
+from keystone_tpu.ops.images.sift import SIFTExtractor, _sep_conv2d, _gaussian_kernel, MAGNIF
+from keystone_tpu.ops.images.lcs import LCSExtractor
+from keystone_tpu.ops.images.core import GrayScaler, PixelScaler
+
+rng = np.random.default_rng(0)
+imgs = bench._fixture_images(128, 256)
+X = jnp.asarray(imgs)
+
+def force(a):
+    np.asarray(jax.tree_util.tree_leaves(a)[0].ravel()[:1])
+
+def timeit(name, fn, *args, reps=4):
+    force(fn(*args))
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter(); force(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    print(f"{name:40s} {best*1e3:9.2f} ms wall", flush=True)
+
+@jax.jit
+def rt(s): return s + 1.0
+force(rt(jnp.float32(1.0)))
+t0=time.perf_counter(); force(rt(jnp.float32(2.0)))
+print(f"RT {1e3*(time.perf_counter()-t0):.1f} ms", flush=True)
+
+# full chain
+full = bench._build_fv_pipeline(rng, 64, 16).fit().jit_batch()
+timeit("full chain", full, X)
+
+# SIFT alone (with gray)
+ext = SIFTExtractor(scale_step=1)
+gray = jax.jit(jax.vmap(lambda im: GrayScaler().apply(PixelScaler().apply(im))))
+Xg = gray(X); force(Xg)
+sift_v = jax.jit(jax.vmap(ext.apply))
+timeit("SIFT (vmapped, gray input)", sift_v, Xg)
+
+# gaussian smooths alone
+@jax.jit
+def smooths(x):
+    acc = jnp.float32(0)
+    for scale in range(4):
+        k = _gaussian_kernel((4 + 2*scale) / MAGNIF)
+        acc = acc + _sep_conv2d(x, k).sum()
+    return acc
+timeit("4x gaussian smooth [sum]", smooths, Xg)
+
+# LCS alone
+lcs_v = jax.jit(jax.vmap(LCSExtractor(4, 16, 6).apply))
+timeit("LCS (vmapped)", lcs_v, X)
