@@ -29,6 +29,9 @@ _EXPORTS = {
     "Pipeline": "keystone_tpu.workflow",
     "Transformer": "keystone_tpu.workflow",
     "Dataset": "keystone_tpu.parallel.dataset",
+    "CompiledPipeline": "keystone_tpu.serving",
+    "MicroBatcher": "keystone_tpu.serving",
+    "ServingMetrics": "keystone_tpu.serving",
 }
 
 

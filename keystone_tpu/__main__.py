@@ -43,8 +43,14 @@ def main(argv=None) -> int:
         print("apps:")
         for name in sorted(APPS):
             print(f"  {name}")
+        print("  serve-bench  (serving engine benchmarks; see "
+              "keystone_tpu/serving/bench.py)")
         return 0 if argv else 2
     app = argv[0]
+    if app == "serve-bench":
+        from keystone_tpu.serving.bench import main as serve_bench_main
+
+        return serve_bench_main(argv[1:])
     if app not in APPS:
         print(f"unknown app {app!r}; run with --help for the list")
         return 2

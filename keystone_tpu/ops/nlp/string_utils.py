@@ -20,17 +20,19 @@ class Tokenizer(Transformer):
     Scala ``String.split`` semantics are reproduced exactly
     (StringUtilsSuite "tokenizer"): a string that STARTS with a
     separator yields a leading empty token (which the reference's
-    downstream TF/vocab nodes then count as a term), trailing empty
-    tokens are removed, and the no-match case returns the original
-    string whole — so ``""`` tokenizes to ``[""]``, Java's documented
-    quirk."""
+    downstream TF/vocab nodes then count as a term); ALL trailing empty
+    tokens are removed, so a separator-only string yields ``[]``; and
+    the no-match case returns the original string whole — so ``""``
+    tokenizes to ``[""]``, Java's documented quirk."""
 
     sep: str = r"[^\w]+"
     vmap_batch = False
 
     def apply(self, s: str):
         parts = re.split(self.sep, s)
-        while len(parts) > 1 and parts[-1] == "":
+        if len(parts) == 1:
+            return parts  # no separator matched: the whole string, as is
+        while parts and parts[-1] == "":
             parts.pop()
         return parts
 

@@ -46,6 +46,62 @@ logger = logging.getLogger(__name__)
 DCN_AXIS = "dcn"
 
 _initialized = False
+_cache_dir: Optional[str] = None
+
+
+def setup_compilation_cache(
+    cache_dir: Optional[str] = None,
+    min_compile_time_secs: float = 0.0,
+) -> Optional[str]:
+    """Wire up JAX's persistent XLA compilation cache (idempotent).
+
+    A restarted server pays ZERO cold compiles for shapes it has seen:
+    ``CompiledPipeline.warmup`` replays each bucket's compile from this
+    on-disk cache instead of re-running XLA (seconds per program). The
+    dir resolves from the argument, ``$KEYSTONE_COMPILE_CACHE``, then
+    ``~/.cache/keystone_tpu/xla``. ``min_compile_time_secs=0`` caches
+    every program — serving wants even fast compiles persisted, unlike
+    one-shot training scripts where tiny entries are churn.
+
+    Returns the cache dir, or None when this jax build lacks the
+    persistent-cache config knobs (the call is then a no-op — serving
+    still works, restarts just recompile)."""
+    global _cache_dir
+    if _cache_dir is not None:
+        return _cache_dir
+    cache_dir = (
+        cache_dir
+        or os.environ.get("KEYSTONE_COMPILE_CACHE")
+        or os.path.join(
+            os.path.expanduser("~"), ".cache", "keystone_tpu", "xla"
+        )
+    )
+    prev_dir = getattr(jax.config, "jax_compilation_cache_dir", None)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(min_compile_time_secs),
+        )
+    except Exception as e:
+        # roll back to the PRE-CALL state so jax config never
+        # contradicts the None return (and a cache the user configured
+        # themselves isn't silently disabled by our failure)
+        try:
+            jax.config.update("jax_compilation_cache_dir", prev_dir)
+        except Exception:
+            pass
+        logger.info("persistent compilation cache unavailable: %s", e)
+        return None
+    try:
+        # cache regardless of entry size where the knob exists
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except AttributeError:
+        pass
+    _cache_dir = cache_dir
+    logger.info("persistent compilation cache at %s", cache_dir)
+    return cache_dir
 
 
 def _looks_like_pod() -> bool:

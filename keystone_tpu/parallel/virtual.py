@@ -74,7 +74,15 @@ def provision_devices(n_devices: int, *, probe_real: bool = True) -> None:
         return  # real platform suffices; leave config alone
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n_devices)
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        # older jax: the device count is an XLA flag, honored only if set
+        # before backend init (which provision_devices guarantees)
+        flag = f"--xla_force_host_platform_device_count={n_devices}"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if flag not in flags:
+            os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
     have = len(jax.devices())
     assert have >= n_devices, (
         f"could not provision {n_devices} virtual CPU devices; got {have}"

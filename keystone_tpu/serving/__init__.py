@@ -1,0 +1,26 @@
+"""Serving subsystem: the deployable half of the SURVEY §7 lowering.
+
+A ``FittedPipeline`` is the trained artifact (the reference's
+serializable ``FittedPipeline``); this package turns it into a
+production inference engine:
+
+- ``CompiledPipeline`` (engine.py): bucketed compiled execution —
+  incoming batches are zero-padded up to a fixed set of row buckets so
+  steady-state traffic compiles at most ``len(buckets)`` XLA programs,
+  with input-buffer donation and an optional mesh-sharded variant.
+- ``MicroBatcher`` (batching.py): adaptive micro-batching — a
+  thread-safe queue that coalesces single-example ``submit()`` requests
+  into the smallest covering bucket under a max-latency deadline.
+- ``ServingMetrics`` (metrics.py): per-bucket compile/dispatch counts,
+  queue depth, p50/p99 latency, examples/sec.
+
+Persistent-compile-cache setup lives in
+``keystone_tpu.parallel.runtime.setup_compilation_cache`` (a restarted
+server warms from disk instead of recompiling).
+"""
+
+from keystone_tpu.serving.batching import MicroBatcher
+from keystone_tpu.serving.engine import CompiledPipeline
+from keystone_tpu.serving.metrics import ServingMetrics
+
+__all__ = ["CompiledPipeline", "MicroBatcher", "ServingMetrics"]

@@ -1,0 +1,201 @@
+"""Adaptive micro-batching: coalesce single-example requests into
+bucketed dispatches.
+
+The serving analogue of the BCD solvers' async-stream discipline
+(ops/learning/block_ls.py double-buffers slabs so the chip never idles):
+here the chip never runs a one-row program per request. ``submit()``
+enqueues an example and returns a ``Future``; a dispatcher thread
+coalesces everything that arrives within a max-latency deadline (or
+until the largest bucket fills, whichever first) into ONE padded
+bucket dispatch through a ``CompiledPipeline``, then resolves each
+request's future with its own row of the result.
+
+Latency/throughput contract: a lone request waits at most ``max_delay``
+before dispatching solo; under load, dispatches fill toward
+``max_batch`` and per-request latency approaches the bucket's compiled
+execution time. Queue depth, coalesce sizes, and request p50/p99 are
+recorded on the shared ``ServingMetrics``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.serving.engine import CompiledPipeline
+
+logger = logging.getLogger(__name__)
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        engine: CompiledPipeline,
+        max_delay_ms: float = 5.0,
+        max_batch: Optional[int] = None,
+    ):
+        self.engine = engine
+        self.max_delay = max_delay_ms / 1e3
+        self.max_batch = max_batch or engine.max_bucket
+        if self.max_batch > engine.max_bucket:
+            raise ValueError(
+                f"max_batch {self.max_batch} exceeds the engine's largest "
+                f"bucket {engine.max_bucket}"
+            )
+        self.metrics = engine.metrics
+        # spec (treedef + leaf shapes/dtypes) of the CURRENT pending
+        # window, set by the window's first submit and cleared when the
+        # window drains: a mismatched request is rejected AT submit()
+        # so one ragged example can't fail a coalesced window of
+        # unrelated requests at stack time — and a bad request poisons
+        # at most its own window, never the batcher's lifetime
+        self._window_spec = None
+        self._pending: List[Tuple[Any, Future, float]] = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._loop, name="keystone-microbatcher", daemon=True
+        )
+        self._worker.start()
+
+    # -- client side -------------------------------------------------------
+
+    @staticmethod
+    def _leaf_spec(a):
+        # shape/dtype WITHOUT materializing a device array — submit()
+        # is the per-request hot path; the real conversion happens once
+        # per window at stack time
+        if hasattr(a, "shape") and hasattr(a, "dtype"):
+            return tuple(a.shape), str(a.dtype)
+        a = np.asarray(a)
+        return a.shape, str(a.dtype)
+
+    def _example_spec(self, example: Any):
+        leaves, treedef = jax.tree_util.tree_flatten(example)
+        return treedef, tuple(self._leaf_spec(a) for a in leaves)
+
+    def submit(self, example: Any) -> "Future":
+        """Enqueue one example (a pytree WITHOUT the leading batch axis);
+        the returned future resolves to that example's pipeline output.
+        Raises ``ValueError`` when the example's structure/shape/dtype
+        disagrees with the current window's first example."""
+        spec = self._example_spec(example)
+        fut: Future = Future()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            if not self._pending:
+                self._window_spec = spec
+            elif spec != self._window_spec:
+                raise ValueError(
+                    f"example spec {spec} does not match this window's "
+                    f"spec {self._window_spec}"
+                )
+            self._pending.append((example, fut, time.perf_counter()))
+            self.metrics.set_queue_depth(len(self._pending))
+            self._cond.notify()
+        return fut
+
+    def close(self, timeout: Optional[float] = 10.0) -> None:
+        """Flush pending requests and stop the dispatcher thread. If the
+        dispatcher can't drain within ``timeout`` (e.g. it is inside a
+        cold multi-second XLA compile) this logs a warning and returns —
+        the daemon worker keeps resolving in-flight futures as long as
+        the process lives. Futures the dead-worker case would strand are
+        failed rather than left to hang their waiters."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify()
+        self._worker.join(timeout)
+        if self._worker.is_alive():
+            logger.warning(
+                "MicroBatcher dispatcher still running after %.1fs "
+                "close timeout (cold compile in flight?); pending "
+                "futures will resolve as it finishes", timeout,
+            )
+            return
+        # a CLEAN worker exit provably drains _pending (submit rejects
+        # once closed); anything left here means the dispatcher thread
+        # died on an unexpected error outside _dispatch's catch — fail
+        # those futures rather than hang their waiters
+        with self._cond:
+            stranded = self._pending[:]
+            del self._pending[:]
+        for _, fut, _ in stranded:
+            if not fut.done():
+                fut.set_exception(
+                    RuntimeError("MicroBatcher closed before dispatch")
+                )
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- dispatcher side ---------------------------------------------------
+
+    def _take_batch(self) -> List[Tuple[Any, Future, float]]:
+        """Block until there's work, then wait out the oldest request's
+        deadline (or a full batch, or close) and take up to max_batch."""
+        with self._cond:
+            while not self._pending and not self._closed:
+                self._cond.wait()
+            if not self._pending:
+                return []  # closed and drained
+            deadline = self._pending[0][2] + self.max_delay
+            while (
+                len(self._pending) < self.max_batch
+                and not self._closed
+            ):
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0 or not self._cond.wait(remaining):
+                    break
+            batch = self._pending[: self.max_batch]
+            del self._pending[: len(batch)]
+            self.metrics.set_queue_depth(len(self._pending))
+            return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                return
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: List[Tuple[Any, Future, float]]) -> None:
+        examples = [ex for ex, _, _ in batch]
+        futures = [f for _, f, _ in batch]
+        enqueued = [t for _, _, t in batch]
+        self.metrics.record_coalesce(len(batch))
+        try:
+            def stack(*xs):
+                # host payloads stack on HOST: the whole window then
+                # crosses to the device as ONE transfer inside the
+                # engine, not one per example
+                if any(isinstance(x, jax.Array) for x in xs):
+                    return jnp.stack([jnp.asarray(x) for x in xs])
+                return np.stack([np.asarray(x) for x in xs])
+
+            stacked = jax.tree_util.tree_map(stack, *examples)
+            out = self.engine.apply(stacked, sync=True, owned=True)
+            done = time.perf_counter()
+            for i, fut in enumerate(futures):
+                row = jax.tree_util.tree_map(lambda a, i=i: a[i], out)
+                try:
+                    fut.set_result(row)
+                except Exception:
+                    continue  # caller cancelled this request; the rest
+                    # of the batch must still get their results
+                self.metrics.record_request(done - enqueued[i])
+        except Exception as e:  # resolve, never hang callers
+            for fut in futures:
+                if not fut.done():
+                    fut.set_exception(e)

@@ -1,0 +1,255 @@
+"""Serving benchmarks: the three tracked serving metrics.
+
+- ``serving_cold_vs_warm_latency`` — one shape, cold (trace + XLA
+  compile + dispatch) vs warm (compiled dispatch) latency through the
+  engine; ``speedup`` is the whole point of bucketing + warmup + the
+  persistent compile cache, and the acceptance floor is warm p50 >= 10x
+  faster than cold.
+- ``serving_bucketed_throughput`` — examples/sec through a bucketed
+  engine fed every batch size 1..max_bucket (the steady-state traffic
+  mix that would recompile per-request without buckets), with the
+  engine's compile/padding counters attached.
+- ``serving_microbatch_p99`` — p99 end-to-end request latency of
+  concurrent single-example ``submit()``s coalesced by the
+  ``MicroBatcher`` under a small deadline.
+
+Callable standalone (``python -m keystone_tpu serve-bench``) or from
+the repo-level ``bench.py`` which passes its own ``emit`` so rows land
+in the round's BENCH JSON with ``vs_baseline`` wiring (null for now —
+the reference published no serving numbers; the field exists so future
+rounds can ratio against THESE rows).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.workflow.api import Transformer
+
+
+@dataclasses.dataclass(eq=False)
+class _Affine(Transformer):
+    """Per-example tanh(x @ W + b) — enough real work per node that the
+    staged program isn't trivially constant-folded."""
+
+    W: Any
+    b: Any
+
+    def apply(self, x):
+        return jnp.tanh(x @ self.W + self.b)
+
+
+def build_pipeline(d: int = 256, hidden: int = 512, depth: int = 4):
+    """An estimator-free array-mode chain -> FittedPipeline (depth
+    matmul nodes: a realistic compile cost for the cold/warm row)."""
+    rng = np.random.default_rng(0)
+    dims = [d] + [hidden] * (depth - 1) + [d]
+    pipe = None
+    for i in range(depth):
+        w = jnp.asarray(
+            rng.standard_normal((dims[i], dims[i + 1])).astype(np.float32)
+            / np.sqrt(dims[i])
+        )
+        b = jnp.asarray(np.zeros(dims[i + 1], np.float32))
+        node = _Affine(w, b)
+        pipe = node.to_pipeline() if pipe is None else pipe.and_then(node)
+    return pipe.to_pipeline().fit()
+
+
+def bench_cold_vs_warm(
+    emit, fitted, buckets: Sequence[int], d: int, warm_reps: int = 30
+) -> None:
+    import jax
+
+    engine = fitted.compiled(buckets=buckets)
+    rng = np.random.default_rng(1)
+    n = max(1, buckets[0] - 1)  # padded path, not the exact bucket size
+    x = rng.standard_normal((n, d)).astype(np.float32)
+
+    # the cold number must measure a REAL XLA compile: with the
+    # persistent cache wired (bench.py main() does), a rerun would
+    # replay the executable from disk and deflate cold_ms — so the
+    # cache is detached for exactly this first dispatch
+    cache_dir = None
+    try:
+        cache_dir = jax.config.jax_compilation_cache_dir
+        jax.config.update("jax_compilation_cache_dir", None)
+    except AttributeError:
+        pass
+    try:
+        t0 = time.perf_counter()
+        engine.apply(x, sync=True)
+        cold_ms = (time.perf_counter() - t0) * 1e3
+    finally:
+        if cache_dir is not None:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+    assert engine.metrics.compile_count == 1, engine.metrics.summary()
+
+    warm = []
+    for _ in range(warm_reps):
+        t0 = time.perf_counter()
+        engine.apply(x, sync=True)
+        warm.append((time.perf_counter() - t0) * 1e3)
+    assert engine.metrics.compile_count == 1, (
+        "warm dispatches retraced: " + str(engine.metrics.summary())
+    )
+    warm_p50 = float(np.percentile(warm, 50))
+    speedup = cold_ms / warm_p50
+    emit(
+        "serving_cold_vs_warm_latency", cold_ms, "ms",
+        extra={
+            "warm_p50_ms": round(warm_p50, 3),
+            "warm_p99_ms": round(float(np.percentile(warm, 99)), 3),
+            "speedup": round(speedup, 1),
+            "bucket": engine.bucket_for(n),
+            "batch": n,
+        },
+    )
+
+
+def bench_bucketed_throughput(
+    emit, fitted, buckets: Sequence[int], d: int, passes: int = 3
+) -> None:
+    engine = fitted.compiled(buckets=buckets)
+    rng = np.random.default_rng(2)
+    mb = engine.max_bucket
+    # every size when small, else a spread hitting every bucket + edges
+    # (a remote-dispatch device costs ~100 ms per sync, so the full
+    # 1..max sweep would measure the tunnel, not the engine)
+    if mb <= 32:
+        sizes = list(range(1, mb + 1))
+    else:
+        sizes = sorted(
+            set(int(s) for s in rng.integers(1, mb + 1, 24))
+            | set(engine.buckets) | {1, mb}
+        )
+    xs = {
+        n: rng.standard_normal((n, d)).astype(np.float32) for n in sizes
+    }
+    engine.warmup(example=jnp.zeros((d,), jnp.float32))
+    served = 0
+    t0 = time.perf_counter()
+    for _ in range(passes):
+        for n, x in xs.items():
+            engine.apply(x, sync=True)
+            served += n
+    dt = time.perf_counter() - t0
+    summary = engine.metrics.summary()
+    assert engine.metrics.compile_count <= len(engine.buckets), summary
+    emit(
+        "serving_bucketed_throughput", served / dt, "examples/sec",
+        extra={
+            "distinct_batch_sizes": len(xs),
+            "compiles": engine.metrics.compile_count,
+            "buckets": list(engine.buckets),
+            "padded_rows": summary["padded_rows"],
+            "dispatch_p50_ms": summary["dispatch_p50_ms"],
+            "dispatch_p99_ms": summary["dispatch_p99_ms"],
+        },
+    )
+
+
+def bench_microbatch(
+    emit, fitted, buckets: Sequence[int], d: int,
+    n_requests: int = 256, n_threads: int = 8, max_delay_ms: float = 2.0,
+) -> None:
+    from keystone_tpu.serving.batching import MicroBatcher
+
+    engine = fitted.compiled(buckets=buckets)
+    engine.warmup(example=jnp.zeros((d,), jnp.float32))
+    rng = np.random.default_rng(3)
+    examples = rng.standard_normal((n_requests, d)).astype(np.float32)
+    futures = [None] * n_requests
+    t0 = time.perf_counter()
+    with MicroBatcher(engine, max_delay_ms=max_delay_ms) as mb:
+
+        def client(tid):
+            for i in range(tid, n_requests, n_threads):
+                futures[i] = mb.submit(examples[i])
+
+        threads = [
+            threading.Thread(target=client, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for f in futures:
+            f.result(timeout=30)
+    dt = time.perf_counter() - t0
+    m = engine.metrics
+    p99 = m.request_latency.p99
+    emit(
+        "serving_microbatch_p99", (p99 or 0.0) * 1e3, "ms",
+        extra={
+            "requests": n_requests,
+            "client_threads": n_threads,
+            "max_delay_ms": max_delay_ms,
+            "request_p50_ms": round((m.request_latency.p50 or 0) * 1e3, 3),
+            "max_coalesced": m.max_coalesced,
+            "dispatches": m.dispatches.total,
+            "requests_per_sec": round(n_requests / dt, 1),
+        },
+    )
+
+
+def run_serving_benches(
+    emit,
+    d: int = 256,
+    hidden: int = 512,
+    depth: int = 4,
+    buckets: Sequence[int] = (8, 32, 128),
+) -> None:
+    fitted = build_pipeline(d, hidden, depth)
+    bench_cold_vs_warm(emit, fitted, buckets, d)
+    bench_bucketed_throughput(emit, fitted, buckets, d)
+    bench_microbatch(emit, fitted, buckets, d)
+
+
+def main(argv=None) -> int:
+    """``python -m keystone_tpu serve-bench [--buckets 8,32,128] ...``"""
+    import argparse
+
+    from keystone_tpu.parallel.runtime import setup_compilation_cache
+
+    ap = argparse.ArgumentParser(
+        prog="keystone_tpu serve-bench", description=__doc__
+    )
+    ap.add_argument("--buckets", default="8,32,128",
+                    help="comma-separated row buckets")
+    ap.add_argument("--d", type=int, default=256,
+                    help="feature dim of the bench pipeline")
+    ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--depth", type=int, default=4,
+                    help="number of matmul nodes in the bench pipeline")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="skip persistent-compile-cache setup")
+    args = ap.parse_args(argv)
+    if not args.no_cache:
+        setup_compilation_cache()
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+
+    def emit(metric, value, unit, vs=None, extra=None):
+        row = {
+            "metric": metric,
+            "value": round(value, 2) if value is not None else None,
+            "unit": unit,
+            "vs_baseline": round(vs, 2) if vs else None,
+        }
+        if extra:
+            row.update(extra)
+        print(json.dumps(row), flush=True)
+
+    run_serving_benches(
+        emit, d=args.d, hidden=args.hidden, depth=args.depth,
+        buckets=buckets,
+    )
+    return 0

@@ -1,0 +1,272 @@
+"""Bucketed compiled execution for ``FittedPipeline``.
+
+``FittedPipeline.jit_batch`` stages the whole batched apply path into
+one XLA program — but one program PER BATCH SHAPE: every distinct
+request size costs a full compile (seconds on a cold shape). A
+``CompiledPipeline`` instead fixes a small set of power-of-two row
+buckets, zero-pads each incoming batch up to the smallest covering
+bucket, and dispatches the bucket's compiled program; steady-state
+traffic therefore compiles at most ``len(buckets)`` programs, however
+many distinct batch sizes arrive. Zero pad rows are safe by the
+``Dataset`` padding discipline (parallel/dataset.py: rows past ``n``
+are zeros and transformers must map them to values safe to keep as
+padding); outputs are sliced back to the valid rows.
+
+Input buffers the engine stages are donated to XLA on backends that
+support donation (TPU/GPU), so serving doesn't hold two copies of each
+padded batch. The optional sharded variant places each staged batch
+over the mesh data axis for multi-chip serving — same program, one
+compile per bucket, XLA inserts the collectives.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.parallel import mesh as mesh_lib
+from keystone_tpu.parallel.dataset import Dataset, _leading_dim
+from keystone_tpu.serving.metrics import ServingMetrics
+
+DEFAULT_BUCKETS = (8, 64, 512)
+
+
+def _round_up(n: int, mult: int) -> int:
+    return -(-n // mult) * mult
+
+
+class CompiledPipeline:
+    """A ``FittedPipeline`` behind a fixed set of compiled batch shapes.
+
+    Parameters
+    ----------
+    pipeline:  the fitted (transformer-only) pipeline; its whole batched
+               apply path must be traceable (array-mode nodes only —
+               host-side items-mode nodes can't stage; use
+               ``FittedPipeline.apply`` for those).
+    buckets:   ascending row buckets; a batch of n rows dispatches the
+               smallest bucket >= n, and batches larger than the biggest
+               bucket are chunked through it.
+    donate:    donate staged input buffers to XLA (auto-disabled on
+               backends without donation support, e.g. CPU).
+    shard:     place each staged batch over the mesh data axis
+               (multi-chip serving). Buckets are rounded up to a
+               multiple of the mesh's data-shard count so every shard
+               gets equal rows.
+    """
+
+    def __init__(
+        self,
+        pipeline,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        *,
+        donate: bool = True,
+        shard: bool = False,
+        mesh=None,
+        metrics: Optional[ServingMetrics] = None,
+    ):
+        if not buckets:
+            raise ValueError("need at least one bucket")
+        if any(b <= 0 for b in buckets):
+            raise ValueError(f"buckets must be positive: {buckets}")
+        self.pipeline = pipeline
+        self.shard = shard
+        self.mesh = mesh
+        if shard:
+            m = mesh or mesh_lib.current_mesh()
+            self.mesh = m
+            nshards = mesh_lib.n_data_shards(m)
+            buckets = [_round_up(b, nshards) for b in buckets]
+        self.buckets: Tuple[int, ...] = tuple(sorted(set(int(b) for b in buckets)))
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.donate = donate and jax.default_backend() in ("tpu", "gpu")
+        self._fns: Dict[int, Callable] = {}
+        # a MicroBatcher worker and direct apply() callers may race to
+        # create a bucket's jit fn; two fns would mean two traces, and
+        # the <= len(buckets) compile bound is the subsystem's contract
+        self._fn_lock = threading.Lock()
+
+    # -- compiled-program management ---------------------------------------
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket covering ``n`` rows (callers chunk above the
+        largest bucket)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"batch of {n} rows exceeds the largest bucket "
+            f"{self.max_bucket}; chunk it (engine.apply does)"
+        )
+
+    def _fn(self, bucket: int) -> Callable:
+        fn = self._fns.get(bucket)
+        if fn is not None:
+            return fn
+        with self._fn_lock:
+            fn = self._fns.get(bucket)
+            if fn is not None:
+                return fn
+            run = self.pipeline._batch_run
+            metrics = self.metrics
+
+            def staged(arr):
+                # executes at TRACE time only — one increment per XLA
+                # compile of this bucket, zero on compiled dispatches
+                metrics.record_trace(bucket)
+                return run(arr)
+
+            fn = jax.jit(
+                staged, donate_argnums=(0,) if self.donate else ()
+            )
+            self._fns[bucket] = fn
+            return fn
+
+    # -- staging -----------------------------------------------------------
+
+    def _stage(
+        self, tree: Any, rows: int, bucket: int, owned: bool = False
+    ) -> Any:
+        """Pad a pytree of row-major arrays up to ``bucket`` rows with
+        zeros (valid by the Dataset zero-pad discipline) and place it.
+        ``owned=True`` promises the buffers are engine/batcher-private
+        (safe to donate without the protective copy)."""
+        pad = bucket - rows
+
+        def pad_leaf(a):
+            # caller-owned only if it arrived as a device array; numpy
+            # input becomes an engine-private buffer on the H2D transfer
+            caller_owned = isinstance(a, jax.Array) and not owned
+            a = jnp.asarray(a)
+            if pad:
+                return jnp.concatenate(
+                    [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)]
+                )
+            if self.donate and caller_owned:
+                # exact-size caller-owned buffer: copy so donation can't
+                # invalidate an array the caller still holds
+                return jnp.array(a, copy=True)
+            return a
+
+        staged = jax.tree_util.tree_map(pad_leaf, tree)
+        if self.shard:
+            staged = jax.tree_util.tree_map(
+                lambda a: jax.device_put(
+                    a, mesh_lib.data_sharding(self.mesh, ndim=a.ndim)
+                ),
+                staged,
+            )
+        return staged
+
+    # -- serving entry points ----------------------------------------------
+
+    def apply(
+        self, data: Any, sync: bool = False, owned: bool = False
+    ) -> Any:
+        """Serve one batch: pad to the covering bucket (chunking through
+        the largest bucket when oversized), dispatch the compiled
+        program(s), and return outputs sliced to the valid rows.
+
+        ``data`` is a Dataset, an array, or a pytree of arrays sharing a
+        leading example axis. ``sync=True`` blocks until the whole
+        result is ready (one host sync, after every chunk is
+        enqueued). ``owned=True`` asserts the input buffers belong to
+        the engine's caller-of-record (MicroBatcher, warmup) and may be
+        donated without the exact-bucket-size protective copy — don't
+        pass it for arrays you still need."""
+        if isinstance(data, Dataset):
+            rows = data.n
+            tree = data.array()
+        else:
+            tree = data
+            rows = _leading_dim(tree)
+        if rows == 0:
+            raise ValueError("cannot serve an empty batch")
+        outs: List[Any] = []
+        # when chunking happened every slice is a strict subrange —
+        # always a fresh engine-private buffer, safe to donate without
+        # the protective copy; only the single-chunk identity slice can
+        # alias the caller's array
+        chunk_owned = owned or rows > self.max_bucket
+        start = 0
+        while start < rows:
+            take = min(self.max_bucket, rows - start)
+            chunk = jax.tree_util.tree_map(
+                lambda a: a[start : start + take], tree
+            )
+            # every chunk enqueues async — staging chunk k+1 overlaps
+            # execution of chunk k; the one host sync comes at the end
+            outs.append(self._dispatch(chunk, take, owned=chunk_owned))
+            start += take
+        result = outs[0] if len(outs) == 1 else jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *outs
+        )
+        if sync:
+            jax.block_until_ready(result)
+        return result
+
+    def _dispatch(self, chunk: Any, rows: int, owned: bool = False) -> Any:
+        bucket = self.bucket_for(rows)
+        t0 = time.perf_counter()
+        staged = self._stage(chunk, rows, bucket, owned=owned)
+        out = self._fn(bucket)(staged)
+        valid = jax.tree_util.tree_map(lambda a: a[:rows], out)
+        self.metrics.record_dispatch(
+            bucket, rows, time.perf_counter() - t0
+        )
+        return valid
+
+    def warmup(
+        self,
+        example: Any = None,
+        batch: Any = None,
+        buckets: Optional[Sequence[int]] = None,
+    ) -> Dict[int, float]:
+        """Compile every bucket up front (zero cold compiles at traffic
+        time; with the persistent compilation cache wired — see
+        ``parallel.runtime.setup_compilation_cache`` — a restarted
+        server replays these compiles from disk).
+
+        The per-example shape/dtype spec comes from ``example`` (a
+        pytree for ONE example, no leading axis) or ``batch`` (a pytree
+        WITH a leading axis, e.g. any representative request). Returns
+        bucket -> compile wall seconds."""
+        if (example is None) == (batch is None):
+            raise ValueError("pass exactly one of example= or batch=")
+        if batch is not None and isinstance(batch, Dataset):
+            batch = batch.array()
+        leaves, treedef = jax.tree_util.tree_flatten(
+            batch if batch is not None else example
+        )
+        drop = 1 if batch is not None else 0
+        specs = [
+            (jnp.asarray(a).shape[drop:], jnp.asarray(a).dtype)
+            for a in leaves
+        ]
+        want = list(buckets) if buckets is not None else list(self.buckets)
+        unknown = [b for b in want if b not in self.buckets]
+        if unknown:  # validate BEFORE compiling anything: a bad bucket
+            # late in the list must not leave a half-warmed engine
+            raise ValueError(
+                f"unknown bucket(s) {unknown} (have {self.buckets})"
+            )
+        times: Dict[int, float] = {}
+        for b in want:
+            zeros = treedef.unflatten(
+                [jnp.zeros((b,) + s, d) for s, d in specs]
+            )
+            t0 = time.perf_counter()
+            out = self._fn(b)(self._stage(zeros, b, b, owned=True))
+            jax.block_until_ready(out)
+            times[b] = time.perf_counter() - t0
+        return times
+
+    __call__ = apply

@@ -1,0 +1,120 @@
+"""Serving observability.
+
+One ``ServingMetrics`` instance rides along with each engine (and is
+shared with its ``MicroBatcher``): per-bucket XLA compile counts — the
+number the bucketed design exists to bound — per-bucket dispatch
+counts, padded-vs-valid example counts (padding waste), dispatch and
+end-to-end request latency percentiles, and a queue-depth gauge.
+
+Built on the generic ``Counter`` / ``LatencyRecorder`` primitives in
+``utils/profiling.py`` so the same machinery serves training-side
+instrumentation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from keystone_tpu.utils.profiling import Counter, LatencyRecorder
+
+
+class ServingMetrics:
+    def __init__(self, latency_window: int = 4096):
+        # bucket -> number of XLA traces (each trace = one compile)
+        self.compiles = Counter()
+        # bucket -> number of compiled-program dispatches
+        self.dispatches = Counter()
+        # valid examples served / padded rows shipped (waste tracking)
+        self.examples = Counter()
+        self.padded_rows = Counter()
+        # wall time of engine dispatches: pad/placement + compiled-call
+        # ENQUEUE (execution is async; apply(sync=True) blocks once at
+        # the end, outside this number), plus trace+compile on a
+        # bucket's FIRST dispatch (warmup moves that cost out of the
+        # traffic distribution). End-to-end serving latency lives in
+        # request_latency and in the bench's own wall timers.
+        self.dispatch_latency = LatencyRecorder(latency_window)
+        # enqueue-to-future-resolution time of micro-batched requests
+        self.request_latency = LatencyRecorder(latency_window)
+        self._queue_depth = 0
+        self._coalesced_max = 0
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    # -- engine-side hooks -------------------------------------------------
+
+    def record_trace(self, bucket: int) -> None:
+        self.compiles.inc(bucket)
+
+    def record_dispatch(
+        self, bucket: int, n_valid: int, seconds: float
+    ) -> None:
+        self.dispatches.inc(bucket)
+        self.examples.inc(None, n_valid)
+        self.padded_rows.inc(None, bucket - n_valid)
+        self.dispatch_latency.record(seconds)
+
+    # -- batcher-side hooks ------------------------------------------------
+
+    def set_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self._queue_depth = depth
+
+    def record_coalesce(self, size: int) -> None:
+        with self._lock:
+            self._coalesced_max = max(self._coalesced_max, size)
+
+    def record_request(self, seconds: float) -> None:
+        self.request_latency.record(seconds)
+
+    # -- queries -----------------------------------------------------------
+
+    @property
+    def compile_count(self) -> int:
+        return self.compiles.total
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self._queue_depth
+
+    @property
+    def max_coalesced(self) -> int:
+        with self._lock:
+            return self._coalesced_max
+
+    def examples_per_sec(self) -> float:
+        """LIFETIME average (examples since construction / wall time
+        since construction) — it decays over idle periods and includes
+        warmup, so it's a capacity sanity number, not an instantaneous
+        throughput gauge. Benches that need a true rate time their own
+        window (serving/bench.py does)."""
+        dt = time.perf_counter() - self._t0
+        return self.examples.total / dt if dt > 0 else 0.0
+
+    def summary(self) -> Dict:
+        """Flat dict suitable for a bench row's ``extra`` or a log line."""
+
+        def ms(v: Optional[float]) -> Optional[float]:
+            return round(v * 1e3, 3) if v is not None else None
+
+        return {
+            "compiles_per_bucket": {
+                str(k): v for k, v in sorted(self.compiles.snapshot().items())
+            },
+            "dispatches_per_bucket": {
+                str(k): v
+                for k, v in sorted(self.dispatches.snapshot().items())
+            },
+            "examples": self.examples.total,
+            "padded_rows": self.padded_rows.total,
+            "examples_per_sec_lifetime": round(self.examples_per_sec(), 1),
+            "dispatch_p50_ms": ms(self.dispatch_latency.p50),
+            "dispatch_p99_ms": ms(self.dispatch_latency.p99),
+            "request_p50_ms": ms(self.request_latency.p50),
+            "request_p99_ms": ms(self.request_latency.p99),
+            "queue_depth": self.queue_depth,
+            "max_coalesced": self.max_coalesced,
+        }

@@ -545,19 +545,45 @@ class FittedPipeline:
         """The single-example apply path as one jitted function."""
         return jax.jit(lambda x: self._run(x, batch=False))
 
-    def jit_batch(self) -> Callable[[Any], Any]:
+    def _batch_run(self, arr: Any) -> Any:
+        """The traceable whole-batch apply path: array(s) in, array(s)
+        out. Shared staging surface of ``jit_batch`` and the serving
+        engine (serving/engine.py), so the two can't drift. Rows past
+        the valid count are zeros by the Dataset pad discipline; callers
+        slice outputs back to their valid rows."""
+        out = self._run(Dataset.from_array(arr), batch=True)
+        return out.padded() if isinstance(out, Dataset) else out
+
+    def jit_batch(self, donate: bool = False) -> Callable[[Any], Any]:
         """The WHOLE batched apply path as ONE compiled XLA program —
         the SURVEY §7 lowering: array in, array out, every node's
         batch_transform traced into a single staged computation (XLA
         fuses across node boundaries; no per-node dispatch). Requires an
         array-mode transformer chain (host-side items-mode nodes, e.g.
-        string tokenizers, cannot trace — use ``apply`` for those)."""
+        string tokenizers, cannot trace — use ``apply`` for those).
 
-        def run(arr):
-            out = self._run(Dataset.from_array(arr), batch=True)
-            return out.padded() if isinstance(out, Dataset) else out
+        NOTE: one program per distinct batch shape — every new batch
+        size recompiles. For serving arbitrary request sizes use
+        ``compiled()`` (bucketed execution, bounded compiles).
 
-        return jax.jit(run)
+        ``donate=True`` donates the input buffer to XLA (halves peak
+        HBM for the staged batch; the caller's array is consumed)."""
+        return jax.jit(
+            self._batch_run, donate_argnums=(0,) if donate else ()
+        )
+
+    def compiled(self, buckets=None, **kwargs):
+        """This pipeline as a serving engine: bucketed compiled
+        execution with bounded recompiles, input donation, and optional
+        mesh sharding (see serving/engine.py ``CompiledPipeline``)."""
+        from keystone_tpu.serving.engine import (
+            DEFAULT_BUCKETS, CompiledPipeline,
+        )
+
+        return CompiledPipeline(
+            self, buckets if buckets is not None else DEFAULT_BUCKETS,
+            **kwargs,
+        )
 
     def and_then(self, nxt: "FittedPipeline") -> "FittedPipeline":
         g, _, sink_map = self.graph.connect_graph(

@@ -43,14 +43,19 @@ void process_doc(const char* begin, const char* end, int min_order,
   while (end > begin && static_cast<unsigned char>(end[-1]) <= ' ') --end;
 
   // tokenize + per-token FNV-1a over lowercased bytes. Java/Scala
-  // String.split semantics (mirrored by the Python Tokenizer): a doc
-  // that starts with a separator yields a leading EMPTY token, and an
-  // empty doc tokenizes to [""] — both hash to the bare FNV offset
-  // (stable_hash("")).
+  // String.split semantics (mirrored by the Python Tokenizer): an
+  // empty doc is the no-match case and tokenizes to [""] (hash = bare
+  // FNV offset, stable_hash("")); a doc that starts with a separator
+  // yields a leading EMPTY token only when a word token follows —
+  // trailing empties are all stripped, so a separator-only doc yields
+  // ZERO tokens.
   std::vector<uint32_t> token_hashes;
-  if (begin >= end ||
-      !is_word_byte(static_cast<unsigned char>(*begin))) {
+  if (begin >= end) {
     token_hashes.push_back(kFnvOffset);
+  } else if (!is_word_byte(static_cast<unsigned char>(*begin))) {
+    const char* q = begin;
+    while (q < end && !is_word_byte(static_cast<unsigned char>(*q))) ++q;
+    if (q < end) token_hashes.push_back(kFnvOffset);
   }
   const char* p = begin;
   while (p < end) {

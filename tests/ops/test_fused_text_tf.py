@@ -17,13 +17,28 @@ DOCS = [
     "",
     "a b a b a  --  punct,punct;punct",
     "Numbers 123 and under_scores mix_9 OK",
-    # Scala-split leading-empty-token cases: a doc that starts with a
-    # separator AFTER trim emits a "" token, and a punctuation-only doc
-    # tokenizes to [""] — the native path must hash identically
+    # Scala-split edge cases: a doc that starts with a separator AFTER
+    # trim emits a leading "" token (a word token follows), while a
+    # punctuation-only doc strips ALL trailing empties and tokenizes to
+    # [] (Java: "?!?".split("[^\\w]+") is an EMPTY array) — the native
+    # path must hash identically, including emitting zero n-grams for
+    # the separator-only doc
     "!great product",
     "  !! leading punct after trim",
     "?!?",
 ]
+
+
+def test_tokenizer_scala_split_semantics():
+    """The Java/Scala String.split contract the fused path mirrors:
+    no-match returns the whole string (so "" -> [""]), trailing empty
+    tokens are ALL stripped (separator-only input -> []), leading empty
+    tokens are kept."""
+    t = Tokenizer()
+    assert t.apply("") == [""]
+    assert t.apply("?!?") == []
+    assert t.apply("a,b,,") == ["a", "b"]
+    assert t.apply("!great product") == ["", "great", "product"]
 
 
 def _python_reference(doc, orders, nf):

@@ -1,0 +1,135 @@
+"""MicroBatcher: concurrent submits coalesce into one dispatch, every
+future resolves with its own per-example-correct row, deadlines flush
+lone requests, close() drains."""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.serving.batching import MicroBatcher
+from keystone_tpu.serving.engine import CompiledPipeline
+
+from test_engine import D, batch, make_fitted
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    return make_fitted()
+
+
+def test_concurrent_submits_coalesce_and_resolve(fitted):
+    engine = CompiledPipeline(fitted, buckets=(4, 16))
+    engine.warmup(example=jnp.zeros((D,), jnp.float32))
+    n = 16
+    xs = batch(n, seed=7)
+    want = np.asarray(
+        fitted.apply(Dataset.from_array(jnp.asarray(xs))).array()
+    )
+    futures = [None] * n
+    # a generous deadline so every thread's submit lands inside the
+    # first coalescing window (deterministic on a loaded CI box)
+    with MicroBatcher(engine, max_delay_ms=300.0) as mb:
+        barrier = threading.Barrier(4)
+
+        def client(tid):
+            barrier.wait()
+            for i in range(tid, n, 4):
+                futures[i] = mb.submit(xs[i])
+
+        threads = [
+            threading.Thread(target=client, args=(t,)) for t in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        rows = [np.asarray(f.result(timeout=30)) for f in futures]
+    for i in range(n):
+        np.testing.assert_allclose(
+            rows[i], want[i], rtol=1e-5, atol=1e-6
+        )
+    # the requests coalesced instead of dispatching one-by-one
+    assert engine.metrics.max_coalesced >= 2
+    assert engine.metrics.dispatches.total < n + len(engine.buckets)
+    assert engine.metrics.request_latency.count == n
+
+
+def test_deadline_flushes_a_lone_request(fitted):
+    engine = CompiledPipeline(fitted, buckets=(4,))
+    engine.warmup(example=jnp.zeros((D,), jnp.float32))
+    with MicroBatcher(engine, max_delay_ms=10.0) as mb:
+        t0 = time.perf_counter()
+        out = mb.submit(batch(1)[0]).result(timeout=30)
+        dt = time.perf_counter() - t0
+    assert np.asarray(out).shape == (3,)
+    # flushed by the deadline, not by a full bucket (generous ceiling:
+    # CI boxes stall, but a broken deadline hangs until close())
+    assert dt < 20.0
+
+
+def test_full_bucket_dispatches_before_deadline(fitted):
+    engine = CompiledPipeline(fitted, buckets=(4,))
+    engine.warmup(example=jnp.zeros((D,), jnp.float32))
+    xs = batch(4, seed=3)
+    with MicroBatcher(engine, max_delay_ms=10_000.0, max_batch=4) as mb:
+        futures = [mb.submit(x) for x in xs]
+        rows = [np.asarray(f.result(timeout=30)) for f in futures]
+    want = np.asarray(
+        fitted.apply(Dataset.from_array(jnp.asarray(xs))).array()
+    )
+    np.testing.assert_allclose(np.stack(rows), want, rtol=1e-5, atol=1e-6)
+
+
+def test_close_drains_then_rejects(fitted):
+    engine = CompiledPipeline(fitted, buckets=(4,))
+    engine.warmup(example=jnp.zeros((D,), jnp.float32))
+    mb = MicroBatcher(engine, max_delay_ms=5_000.0)
+    fut = mb.submit(batch(1, seed=9)[0])
+    mb.close()
+    assert fut.result(timeout=5) is not None  # flushed, not dropped
+    with pytest.raises(RuntimeError):
+        mb.submit(batch(1)[0])
+
+
+def test_max_batch_validation(fitted):
+    engine = CompiledPipeline(fitted, buckets=(4,))
+    with pytest.raises(ValueError):
+        MicroBatcher(engine, max_batch=8)
+
+
+def test_mismatched_example_rejected_at_submit(fitted):
+    """A ragged request fails ITSELF at submit(); co-batched requests
+    still resolve. The deadline is far longer than the test body so the
+    window deterministically stays open across both submits (the
+    mismatch check is per-window: in a drained window the same request
+    would instead open its own window and fail at dispatch)."""
+    engine = CompiledPipeline(fitted, buckets=(4,))
+    engine.warmup(example=jnp.zeros((D,), jnp.float32))
+    good_x = batch(1, seed=1)[0]
+    bad_x = np.zeros(D + 1, np.float32)
+    with MicroBatcher(engine, max_delay_ms=10_000.0, max_batch=4) as mb:
+        good = mb.submit(good_x)
+        with pytest.raises(ValueError):
+            mb.submit(bad_x)  # wrong feature dim, same open window
+        # close() flushes the window well before the deadline
+    assert np.asarray(good.result(timeout=30)).shape == (3,)
+
+
+def test_error_propagates_to_futures(fitted):
+    """A dispatch-level failure (bad spec opening a window) resolves
+    the affected futures with the exception instead of hanging callers
+    — and poisons only its own window: the next well-formed request
+    opens a fresh window and succeeds."""
+    engine = CompiledPipeline(fitted, buckets=(4,))
+    engine.warmup(example=jnp.zeros((D,), jnp.float32))
+    with MicroBatcher(engine, max_delay_ms=5.0) as mb:
+        fut = mb.submit(jnp.zeros((D + 1,)))  # opens a window whose
+        # spec the pipeline's matmul rejects at trace time
+        with pytest.raises(Exception):
+            fut.result(timeout=30)
+        good = mb.submit(batch(1, seed=2)[0])  # new window, accepted
+        assert np.asarray(good.result(timeout=30)).shape == (3,)

@@ -1,0 +1,168 @@
+"""CompiledPipeline: bounded recompiles, padded-row correctness,
+chunking, warmup, and the sharded variant. Everything here runs on the
+CPU backend (tier-1: JAX_PLATFORMS=cpu) — the engine uses no TPU-only
+APIs on its default path; donation simply disables itself where the
+backend doesn't support it."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.serving.engine import CompiledPipeline
+from keystone_tpu.workflow.api import Transformer
+
+
+@dataclasses.dataclass(eq=False)
+class Affine(Transformer):
+    W: object
+    b: object
+
+    def apply(self, x):
+        return jnp.tanh(x @ self.W + self.b)
+
+
+D = 6
+
+
+def make_fitted():
+    rng = np.random.default_rng(0)
+    w1 = jnp.asarray(rng.standard_normal((D, 8)).astype(np.float32))
+    w2 = jnp.asarray(rng.standard_normal((8, 3)).astype(np.float32))
+    pipe = Affine(w1, jnp.zeros(8, jnp.float32)).and_then(
+        Affine(w2, jnp.ones(3, jnp.float32))
+    )
+    return pipe.fit()
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    return make_fitted()
+
+
+def batch(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, D)).astype(np.float32)
+
+
+def test_recompile_count_bounded(fitted):
+    """The acceptance criterion: >= 6 distinct batch sizes through a
+    2-bucket engine trigger exactly 2 XLA traces — the counting wrapper
+    is the engine's trace hook, which runs at trace time only."""
+    engine = CompiledPipeline(fitted, buckets=(4, 8))
+    sizes = [1, 2, 3, 4, 5, 6, 7, 8]
+    for n in sizes:
+        engine.apply(batch(n, seed=n))
+    assert len(set(sizes)) >= 6
+    assert engine.metrics.compile_count == 2, engine.metrics.summary()
+    assert engine.metrics.compiles.snapshot() == {4: 1, 8: 1}
+    # dispatches: one per request, routed to the covering bucket
+    assert engine.metrics.dispatches.snapshot() == {4: 4, 8: 4}
+
+
+def test_padded_rows_do_not_leak(fitted):
+    """Bucketed output equals the unbucketed interpreter apply on the
+    valid rows."""
+    engine = CompiledPipeline(fitted, buckets=(8,))
+    x = batch(5)
+    got = np.asarray(engine.apply(x))
+    want = np.asarray(
+        fitted.apply(Dataset.from_array(jnp.asarray(x))).array()
+    )
+    assert got.shape == (5, 3)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_oversized_batch_chunks_through_max_bucket(fitted):
+    engine = CompiledPipeline(fitted, buckets=(2, 4))
+    x = batch(11)  # 4 + 4 + 3 -> buckets 4, 4, 4
+    got = np.asarray(engine.apply(x))
+    want = np.asarray(
+        fitted.apply(Dataset.from_array(jnp.asarray(x))).array()
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert engine.metrics.compile_count <= 2
+    assert engine.metrics.examples.total == 11
+
+
+def test_dataset_input_and_bucket_for(fitted):
+    engine = CompiledPipeline(fitted, buckets=(4, 16))
+    assert engine.bucket_for(1) == 4
+    assert engine.bucket_for(4) == 4
+    assert engine.bucket_for(5) == 16
+    with pytest.raises(ValueError):
+        engine.bucket_for(17)
+    ds = Dataset.from_array(jnp.asarray(batch(3)))
+    got = np.asarray(engine.apply(ds, sync=True))
+    want = np.asarray(fitted.apply(ds).array())
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_warmup_precompiles_all_buckets(fitted):
+    engine = CompiledPipeline(fitted, buckets=(2, 4, 8))
+    times = engine.warmup(example=jnp.zeros((D,), jnp.float32))
+    assert sorted(times) == [2, 4, 8]
+    assert engine.metrics.compile_count == 3
+    # traffic after warmup compiles nothing new
+    for n in (1, 3, 5, 7, 8):
+        engine.apply(batch(n, seed=n))
+    assert engine.metrics.compile_count == 3
+
+
+def test_warmup_from_template_batch(fitted):
+    engine = CompiledPipeline(fitted, buckets=(4,))
+    engine.warmup(batch=batch(9))
+    assert engine.metrics.compile_count == 1
+    with pytest.raises(ValueError):
+        engine.warmup()
+    with pytest.raises(ValueError):
+        engine.warmup(example=jnp.zeros(D), buckets=[3])
+
+
+def test_empty_and_bad_buckets(fitted):
+    with pytest.raises(ValueError):
+        CompiledPipeline(fitted, buckets=())
+    with pytest.raises(ValueError):
+        CompiledPipeline(fitted, buckets=(0, 4))
+    engine = CompiledPipeline(fitted, buckets=(4,))
+    with pytest.raises(ValueError):
+        engine.apply(batch(0))
+
+
+def test_metrics_summary_shape(fitted):
+    engine = CompiledPipeline(fitted, buckets=(4,))
+    engine.apply(batch(3), sync=True)
+    s = engine.metrics.summary()
+    assert s["examples"] == 3
+    assert s["padded_rows"] == 1
+    assert s["compiles_per_bucket"] == {"4": 1}
+    assert s["dispatch_p50_ms"] is not None
+
+
+@pytest.mark.needs_mesh8
+def test_sharded_engine_matches_unsharded(fitted, mesh8):
+    """Multi-chip serving: buckets round up to the shard count, the
+    staged batch is placed over the mesh data axis, results match."""
+    engine = CompiledPipeline(fitted, buckets=(2, 12), shard=True)
+    assert engine.buckets == (8, 16)  # rounded to 8 data shards
+    for n in (1, 5, 9, 16):
+        x = batch(n, seed=n)
+        got = np.asarray(engine.apply(x, sync=True))
+        want = np.asarray(
+            fitted.apply(Dataset.from_array(jnp.asarray(x))).array()
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert engine.metrics.compile_count <= 2
+
+
+def test_fitted_pipeline_compiled_constructor(fitted):
+    engine = fitted.compiled(buckets=(4,))
+    assert isinstance(engine, CompiledPipeline)
+    x = batch(2)
+    np.testing.assert_allclose(
+        np.asarray(engine.apply(x)),
+        np.asarray(fitted.apply(Dataset.from_array(jnp.asarray(x))).array()),
+        rtol=1e-5, atol=1e-6,
+    )
