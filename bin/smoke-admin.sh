@@ -1,0 +1,83 @@
+#!/usr/bin/env bash
+# Smoke-test the observability plane end to end: start a toy serving
+# engine with the admin endpoint on an ephemeral port, scrape /healthz
+# and /metrics, verify the per-bucket serving counters are present, and
+# exit nonzero on any failure. CI-friendly: CPU backend, ~15s, no
+# network beyond localhost.
+#
+#   bin/smoke-admin.sh
+set -euo pipefail
+
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+TMPDIR="$(mktemp -d)"
+PORT_FILE="$TMPDIR/port"
+SERVER_LOG="$TMPDIR/server.log"
+cleanup() {
+    [[ -n "${SERVER_PID:-}" ]] && kill "$SERVER_PID" 2>/dev/null || true
+    rm -rf "$TMPDIR"
+}
+trap cleanup EXIT
+
+# toy engine + admin endpoint on port 0 (ephemeral); writes the real
+# port to $PORT_FILE, serves a little traffic, then idles until killed
+JAX_PLATFORMS=cpu PYTHONPATH="$ROOT" python - "$PORT_FILE" >"$SERVER_LOG" 2>&1 <<'PY' &
+import sys, time
+import numpy as np
+from keystone_tpu.observability import enable_tracing, start_admin_server
+from keystone_tpu.serving.bench import build_pipeline
+
+enable_tracing()
+server = start_admin_server(port=0)
+fitted = build_pipeline(d=8, hidden=8, depth=2)
+engine = fitted.compiled(buckets=(4, 8), name="smoke")
+rng = np.random.default_rng(0)
+engine.apply(rng.standard_normal((3, 8)).astype(np.float32), sync=True)
+engine.apply(rng.standard_normal((7, 8)).astype(np.float32), sync=True)
+with open(sys.argv[1], "w") as f:
+    f.write(str(server.port))
+time.sleep(120)  # hold the engine + endpoint alive for the scrape
+PY
+SERVER_PID=$!
+
+for _ in $(seq 1 120); do
+    [[ -s "$PORT_FILE" ]] && break
+    kill -0 "$SERVER_PID" 2>/dev/null || {
+        echo "FAIL: server process died before binding"; cat "$SERVER_LOG"; exit 1; }
+    sleep 0.5
+done
+[[ -s "$PORT_FILE" ]] || { echo "FAIL: no port after 60s"; cat "$SERVER_LOG"; exit 1; }
+PORT="$(cat "$PORT_FILE")"
+BASE="http://127.0.0.1:$PORT"
+echo "admin endpoint up on $BASE"
+
+fetch() {  # fetch <url> — curl when present, stdlib urllib otherwise
+    if command -v curl >/dev/null 2>&1; then
+        curl -fsS --max-time 10 "$1"
+    else
+        python -c 'import sys, urllib.request; \
+sys.stdout.write(urllib.request.urlopen(sys.argv[1], timeout=10).read().decode())' "$1"
+    fi
+}
+
+HEALTH="$(fetch "$BASE/healthz")"
+[[ "$HEALTH" == "ok" ]] || { echo "FAIL: /healthz said '$HEALTH'"; exit 1; }
+echo "PASS /healthz"
+
+METRICS="$(fetch "$BASE/metrics")"
+for want in \
+    'keystone_serving_compiles_total{engine="smoke",bucket="4"} 1' \
+    'keystone_serving_compiles_total{engine="smoke",bucket="8"} 1' \
+    'keystone_serving_dispatches_total{engine="smoke",bucket="4"} 1' \
+    'keystone_serving_examples_total{engine="smoke"} 10' \
+    'quantile="0.99"' \
+    '# TYPE keystone_serving_dispatch_latency_seconds summary'
+do
+    grep -qF "$want" <<<"$METRICS" || {
+        echo "FAIL: /metrics missing: $want"; echo "$METRICS"; exit 1; }
+done
+echo "PASS /metrics ($(grep -c '^keystone_' <<<"$METRICS") keystone series)"
+
+fetch "$BASE/tracez" | grep -q '"serving.dispatch"' || {
+    echo "FAIL: /tracez has no serving.dispatch span"; exit 1; }
+echo "PASS /tracez"
+echo "smoke-admin: all checks passed"

@@ -23,6 +23,27 @@ APPS = {
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if "--admin-port" in argv:
+        # observability plane: /metrics (Prometheus), /varz, /healthz,
+        # /tracez on a background thread, span tracing enabled so
+        # executor/serving spans land in /tracez. Peeled before app
+        # dispatch so EVERY app (and serve-bench) is scrapeable.
+        i = argv.index("--admin-port")
+        try:
+            port = int(argv[i + 1])
+        except (IndexError, ValueError):
+            print("--admin-port requires an integer port (0 = ephemeral)")
+            return 2
+        del argv[i : i + 2]
+        from keystone_tpu.observability import (
+            enable_tracing,
+            start_admin_server,
+        )
+
+        enable_tracing()
+        server = start_admin_server(port=port)
+        print(f"admin endpoint: {server.url()} "
+              "(/metrics /varz /healthz /tracez)", flush=True)
     if "--debug-optimizer" in argv:
         # Per-rule optimizer trace: node-count deltas at INFO, full DOT
         # graphs after each effective rule at DEBUG (reference logs DOT on
@@ -38,13 +59,24 @@ def main(argv=None) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print(
             "usage: python -m keystone_tpu [--debug-optimizer] "
-            "<AppName> [app args...]"
+            "[--admin-port N] <AppName> [app args...]"
         )
         print("apps:")
         for name in sorted(APPS):
             print(f"  {name}")
         print("  serve-bench  (serving engine benchmarks; see "
               "keystone_tpu/serving/bench.py)")
+        print("options:")
+        print("  --admin-port N   serve metrics on http://127.0.0.1:N —"
+              " /metrics (Prometheus")
+        print("                   text exposition of every live engine's"
+              " compile/dispatch/latency")
+        print("                   counters), /varz (JSON), /healthz,"
+              " /tracez (recent spans; add")
+        print("                   ?format=chrome for a Perfetto/"
+              "chrome://tracing trace). N=0 picks")
+        print("                   an ephemeral port. Off by default —"
+              " zero overhead when absent.")
         return 0 if argv else 2
     app = argv[0]
     if app == "serve-bench":

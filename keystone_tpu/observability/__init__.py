@@ -1,0 +1,61 @@
+"""Observability subsystem: one plane for metrics and spans.
+
+KeystoneML's operator decisions (auto-caching, solver selection) run on
+*measured* profiles; this package gives the runtime the same treatment:
+
+- ``MetricsRegistry`` (registry.py): process-global catalogue of named,
+  labeled counters / gauges / latency summaries, built on the
+  ``Counter``/``LatencyRecorder`` primitives in ``utils/profiling.py``.
+  ``ServingMetrics`` registers itself here; the executor, auto-cache
+  profiler, and ``PhaseTimer`` publish here.
+- ``Tracer`` (tracing.py): Dapper-style spans with parent links and a
+  bounded ring of recent spans; Chrome trace-event JSON export for
+  chrome://tracing / Perfetto. Disabled by default (one attribute read
+  per call site when off).
+- ``AdminServer`` (admin.py): stdlib-http background thread serving
+  ``/metrics`` (Prometheus text exposition v0.0.4), ``/varz`` (JSON),
+  ``/healthz``, and ``/tracez`` (recent spans). Off unless started —
+  ``python -m keystone_tpu --admin-port 8080 <App>`` wires it up.
+
+The serving engine's per-bucket compile/dispatch counters, the
+micro-batcher's queue depth and request latency, workflow executor node
+spans, and auto-cache phase timings all land here, so the bucket
+autoscaler (``serving/autoscale.py``) and any external scraper read one
+consistent surface.
+"""
+
+from keystone_tpu.observability.admin import (
+    AdminServer,
+    start_admin_server,
+    stop_admin_server,
+)
+from keystone_tpu.observability.registry import (
+    MetricFamily,
+    MetricsRegistry,
+    Sample,
+    get_global_registry,
+    reset_global_registry,
+)
+from keystone_tpu.observability.tracing import (
+    Span,
+    Tracer,
+    disable_tracing,
+    enable_tracing,
+    get_tracer,
+)
+
+__all__ = [
+    "AdminServer",
+    "MetricFamily",
+    "MetricsRegistry",
+    "Sample",
+    "Span",
+    "Tracer",
+    "disable_tracing",
+    "enable_tracing",
+    "get_global_registry",
+    "get_tracer",
+    "reset_global_registry",
+    "start_admin_server",
+    "stop_admin_server",
+]

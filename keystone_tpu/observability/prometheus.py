@@ -1,0 +1,96 @@
+"""Prometheus text exposition format v0.0.4.
+
+Pure string rendering over ``MetricsRegistry.collect()`` snapshots — no
+sockets here (the admin endpoint serves the result; golden-string tests
+cover the format without one). Reference:
+https://prometheus.io/docs/instrumenting/exposition_formats/
+
+Rules implemented:
+- metric names must match ``[a-zA-Z_:][a-zA-Z0-9_:]*`` — invalid
+  characters are replaced with ``_`` and a leading digit is prefixed;
+- label names must match ``[a-zA-Z_][a-zA-Z0-9_]*`` (no colons);
+- label VALUES may contain any UTF-8 but backslash, double-quote and
+  newline must be escaped as ``\\\\``, ``\\"`` and ``\\n``;
+- HELP text escapes backslash and newline (quotes are legal there);
+- every family gets one ``# HELP`` + ``# TYPE`` block, and the body
+  ends with a trailing newline.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Iterable
+
+from keystone_tpu.observability.registry import MetricFamily
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_METRIC_INVALID = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_INVALID = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def sanitize_metric_name(name: str) -> str:
+    name = _METRIC_INVALID.sub("_", name)
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def sanitize_label_name(name: str) -> str:
+    name = _LABEL_INVALID.sub("_", name)
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def escape_label_value(value: str) -> str:
+    # backslash FIRST or the other escapes' backslashes double-escape
+    return (
+        str(value)
+        .replace("\\", r"\\")
+        .replace('"', r"\"")
+        .replace("\n", r"\n")
+    )
+
+
+def escape_help(text: str) -> str:
+    return str(text).replace("\\", r"\\").replace("\n", r"\n")
+
+
+def format_value(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, int) or (isinstance(v, float) and v.is_integer()):
+        return str(int(v))
+    return repr(float(v))
+
+
+def render_family(family: MetricFamily) -> str:
+    name = sanitize_metric_name(family.name)
+    lines = []
+    if family.help:
+        lines.append(f"# HELP {name} {escape_help(family.help)}")
+    lines.append(f"# TYPE {name} {family.mtype}")
+    for s in family.samples:
+        if s.labels:
+            labelstr = "{" + ",".join(
+                f'{sanitize_label_name(k)}="{escape_label_value(v)}"'
+                for k, v in s.labels.items()
+            ) + "}"
+        else:
+            labelstr = ""
+        lines.append(f"{name}{s.suffix}{labelstr} {format_value(s.value)}")
+    return "\n".join(lines) + "\n"
+
+
+def render(families: Iterable[MetricFamily]) -> str:
+    """Families (from ``MetricsRegistry.collect()``) -> the full
+    exposition body."""
+    return "".join(
+        render_family(f) for f in sorted(families, key=lambda f: f.name)
+    )

@@ -1,0 +1,362 @@
+"""Process-global metrics registry.
+
+One named, labeled catalogue of counters / gauges / latency summaries
+that every subsystem publishes into and every exporter reads out of —
+the single observability plane the serving engine, the micro-batcher,
+the workflow executor, and the auto-cache profiler all feed (scraped by
+the admin endpoint in ``observability/admin.py``, rendered by
+``observability/prometheus.py``).
+
+Built on the existing thread-safe primitives in ``utils/profiling.py``:
+a registry counter is a ``Counter`` whose cells are keyed by
+label-value tuples; a latency summary is one ``LatencyRecorder`` per
+label set. Gauges come in two flavours — settable (a locked float per
+label set) and callback-backed (a zero-state function polled at collect
+time, so live objects like a ``ServingMetrics`` never copy state into
+the registry on the hot path).
+
+Collection is pull-based: ``collect()`` snapshots every metric into
+``MetricFamily`` records. Live objects can also register a *collector*
+callback (held by weakref via a closure, so registration never extends
+an engine's lifetime) that yields families at scrape time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import (
+    Callable,
+    Dict,
+    Iterable,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+from keystone_tpu.utils.profiling import Counter, LatencyRecorder
+
+LabelValues = Tuple[str, ...]
+
+# quantiles a latency summary exports (matches LatencyRecorder's
+# p50/p95/p99 surface; Prometheus summary convention)
+SUMMARY_QUANTILES = (0.5, 0.95, 0.99)
+
+
+@dataclasses.dataclass
+class Sample:
+    """One exposition line: ``name+suffix{labels} value``."""
+
+    suffix: str  # "" for the bare metric, "_count"/"_sum" for summaries
+    labels: Dict[str, str]
+    value: float
+
+
+@dataclasses.dataclass
+class MetricFamily:
+    """A snapshot of one metric and all its label cells."""
+
+    name: str
+    mtype: str  # "counter" | "gauge" | "summary"
+    help: str
+    samples: List[Sample]
+
+
+def _label_dict(
+    labelnames: Sequence[str], values: LabelValues
+) -> Dict[str, str]:
+    return dict(zip(labelnames, values))
+
+
+class _Metric:
+    def __init__(self, name: str, help: str, labelnames: Sequence[str]):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+
+    def _check(self, labels: Optional[LabelValues]) -> LabelValues:
+        values = tuple(str(v) for v in (labels or ()))
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name} expects labels {self.labelnames}, "
+                f"got values {values}"
+            )
+        return values
+
+
+class RegistryCounter(_Metric):
+    """Monotonic counter; cells keyed by label-value tuples."""
+
+    mtype = "counter"
+
+    def __init__(self, name, help, labelnames):
+        super().__init__(name, help, labelnames)
+        self._cells = Counter()
+
+    def inc(self, labels: Optional[LabelValues] = None, by: float = 1):
+        if by < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        self._cells.inc(self._check(labels), by)
+
+    def get(self, labels: Optional[LabelValues] = None) -> float:
+        return self._cells.get(self._check(labels))
+
+    def collect(self) -> MetricFamily:
+        cells = self._cells.snapshot()
+        return MetricFamily(
+            self.name, self.mtype, self.help,
+            [
+                Sample("", _label_dict(self.labelnames, values), v)
+                for values, v in sorted(cells.items())
+            ],
+        )
+
+
+class RegistryGauge(_Metric):
+    """Settable gauge; one locked float per label set."""
+
+    mtype = "gauge"
+
+    def __init__(self, name, help, labelnames):
+        super().__init__(name, help, labelnames)
+        self._cells: Dict[LabelValues, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, labels: Optional[LabelValues] = None):
+        with self._lock:
+            self._cells[self._check(labels)] = float(value)
+
+    def get(self, labels: Optional[LabelValues] = None) -> Optional[float]:
+        with self._lock:
+            return self._cells.get(self._check(labels))
+
+    def collect(self) -> MetricFamily:
+        with self._lock:
+            cells = dict(self._cells)
+        return MetricFamily(
+            self.name, self.mtype, self.help,
+            [
+                Sample("", _label_dict(self.labelnames, values), v)
+                for values, v in sorted(cells.items())
+            ],
+        )
+
+
+class RegistryFuncGauge(_Metric):
+    """Callback-backed gauge: ``fn`` runs at collect time and returns
+    either a float (unlabeled) or a dict of label-values tuple ->
+    float. Zero state, zero hot-path cost."""
+
+    mtype = "gauge"
+
+    def __init__(self, name, help, labelnames, fn: Callable):
+        super().__init__(name, help, labelnames)
+        self._fn = fn
+
+    def collect(self) -> MetricFamily:
+        out = self._fn()
+        if not isinstance(out, dict):
+            out = {(): out}
+        samples = [
+            Sample(
+                "",
+                _label_dict(
+                    self.labelnames, tuple(str(v) for v in values)
+                ),
+                float(v),
+            )
+            for values, v in sorted(out.items())
+            if v is not None
+        ]
+        return MetricFamily(self.name, self.mtype, self.help, samples)
+
+
+class RegistrySummary(_Metric):
+    """Latency summary: one ``LatencyRecorder`` per label set, exported
+    as Prometheus quantile samples plus ``_count``/``_sum``."""
+
+    mtype = "summary"
+
+    def __init__(self, name, help, labelnames, window: int = 4096):
+        super().__init__(name, help, labelnames)
+        self._window = window
+        self._cells: Dict[LabelValues, LatencyRecorder] = {}
+        self._lock = threading.Lock()
+
+    def recorder(
+        self, labels: Optional[LabelValues] = None
+    ) -> LatencyRecorder:
+        """The live recorder for one label set (cacheable by callers so
+        the per-observation path is one deque append)."""
+        values = self._check(labels)
+        with self._lock:
+            rec = self._cells.get(values)
+            if rec is None:
+                rec = self._cells[values] = LatencyRecorder(self._window)
+            return rec
+
+    def observe(self, seconds: float, labels: Optional[LabelValues] = None):
+        self.recorder(labels).record(seconds)
+
+    def collect(self) -> MetricFamily:
+        with self._lock:
+            cells = dict(self._cells)
+        samples: List[Sample] = []
+        for values, rec in sorted(cells.items()):
+            snap = rec.snapshot()
+            base = _label_dict(self.labelnames, values)
+            for q in SUMMARY_QUANTILES:
+                v = snap[f"p{int(q * 100)}"]
+                if v is not None:
+                    samples.append(
+                        Sample("", {**base, "quantile": repr(q)}, v)
+                    )
+            samples.append(Sample("_count", base, snap["count"]))
+            samples.append(Sample("_sum", base, snap["total"]))
+        return MetricFamily(self.name, self.mtype, self.help, samples)
+
+
+class MetricsRegistry:
+    """The named catalogue. ``counter``/``gauge``/``gauge_func``/
+    ``summary`` are get-or-create: re-registering the same name with the
+    same type and labelnames returns the existing metric (subsystems in
+    different modules can share a family); a mismatch raises."""
+
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._collectors: List[Callable[[], Iterable[MetricFamily]]] = []
+        self._lock = threading.Lock()
+
+    # -- registration ------------------------------------------------------
+
+    def _get_or_create(self, cls, name, help, labelnames, **kw):
+        labelnames = tuple(labelnames)
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if (
+                    type(existing) is not cls
+                    or existing.labelnames != labelnames
+                ):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{type(existing).__name__}{existing.labelnames}, "
+                        f"asked for {cls.__name__}{labelnames}"
+                    )
+                return existing
+            metric = cls(name, help, labelnames, **kw)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(
+        self, name: str, help: str = "", labelnames: Sequence[str] = ()
+    ) -> RegistryCounter:
+        return self._get_or_create(RegistryCounter, name, help, labelnames)
+
+    def gauge(
+        self, name: str, help: str = "", labelnames: Sequence[str] = ()
+    ) -> RegistryGauge:
+        return self._get_or_create(RegistryGauge, name, help, labelnames)
+
+    def gauge_func(
+        self,
+        name: str,
+        fn: Callable,
+        help: str = "",
+        labelnames: Sequence[str] = (),
+    ) -> RegistryFuncGauge:
+        return self._get_or_create(
+            RegistryFuncGauge, name, help, labelnames, fn=fn
+        )
+
+    def summary(
+        self,
+        name: str,
+        help: str = "",
+        labelnames: Sequence[str] = (),
+        window: int = 4096,
+    ) -> RegistrySummary:
+        return self._get_or_create(
+            RegistrySummary, name, help, labelnames, window=window
+        )
+
+    def register_collector(
+        self, fn: Callable[[], Optional[Iterable[MetricFamily]]]
+    ) -> None:
+        """A callback polled at collect time; return an iterable of
+        ``MetricFamily`` or None to be pruned (the ServingMetrics
+        bridge returns None once its engine is garbage-collected)."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    # -- scraping ----------------------------------------------------------
+
+    def collect(self) -> List[MetricFamily]:
+        with self._lock:
+            metrics = list(self._metrics.values())
+            collectors = list(self._collectors)
+        families = [m.collect() for m in metrics]
+        dead = []
+        for fn in collectors:
+            out = fn()
+            if out is None:
+                dead.append(fn)
+                continue
+            families.extend(out)
+        if dead:
+            with self._lock:
+                self._collectors = [
+                    f for f in self._collectors if f not in dead
+                ]
+        # merge same-name families collectors may emit in parallel
+        # (several engines export keystone_serving_* under different
+        # engine labels) so exposition has one TYPE block per name
+        merged: Dict[str, MetricFamily] = {}
+        for fam in families:
+            cur = merged.get(fam.name)
+            if cur is None:
+                merged[fam.name] = dataclasses.replace(
+                    fam, samples=list(fam.samples)
+                )
+            else:
+                cur.samples.extend(fam.samples)
+        return list(merged.values())
+
+    def varz(self) -> Dict:
+        """The whole registry as one plain-JSON-able dict (``/varz``)."""
+        out: Dict = {}
+        for fam in self.collect():
+            entry = out.setdefault(
+                fam.name, {"type": fam.mtype, "help": fam.help, "values": []}
+            )
+            for s in fam.samples:
+                entry["values"].append(
+                    {
+                        "suffix": s.suffix,
+                        "labels": s.labels,
+                        "value": s.value,
+                    }
+                )
+        return out
+
+
+_global_registry: Optional[MetricsRegistry] = None
+_global_lock = threading.Lock()
+
+
+def get_global_registry() -> MetricsRegistry:
+    """The process-global registry every subsystem publishes into."""
+    global _global_registry
+    if _global_registry is None:
+        with _global_lock:
+            if _global_registry is None:
+                _global_registry = MetricsRegistry()
+    return _global_registry
+
+
+def reset_global_registry() -> None:
+    """Drop the process-global registry (tests)."""
+    global _global_registry
+    with _global_lock:
+        _global_registry = None

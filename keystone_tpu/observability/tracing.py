@@ -1,0 +1,227 @@
+"""Span tracing: start/end spans with parent links, a bounded ring of
+recent spans, and Chrome trace-event JSON export.
+
+Dapper-style application-level spans for the interpret layer — the JAX
+profiler (``utils.profiling.trace``) already covers the XLA/device
+substrate, but nothing records *why* the device was asked to do work:
+which executor node, which serving dispatch, which coalesced window.
+Spans nest via a thread-local stack, so a ``serving.dispatch`` span
+started inside a ``microbatch.dispatch`` span carries its parent's id —
+``/tracez`` (observability/admin.py) shows the tree, and
+``to_chrome_trace()`` exports the ring as Chrome trace-event JSON
+(the ``{"traceEvents": [...]}`` object format) loadable in
+chrome://tracing or Perfetto.
+
+Disabled is the default and costs one attribute read per ``span()``
+call (a shared no-op context manager is returned; nothing is recorded,
+no lock is taken). ``enable_tracing()`` flips the process-global
+tracer on.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional
+
+DEFAULT_CAPACITY = 2048
+
+_ids = itertools.count(1)
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    span_id: int
+    parent_id: Optional[int]
+    start_s: float  # epoch seconds (time.time clock)
+    duration_s: float
+    thread_id: int
+    attrs: Dict[str, Any]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_s": self.start_s,
+            "duration_ms": round(self.duration_s * 1e3, 6),
+            "thread_id": self.thread_id,
+            "attrs": dict(self.attrs),
+        }
+
+
+class _ActiveSpan:
+    """A span in flight; exposes ``set_attr`` and is the context object
+    ``Tracer.span()`` yields."""
+
+    __slots__ = ("name", "span_id", "parent_id", "attrs", "_t0", "_wall")
+
+    def __init__(self, name: str, parent_id: Optional[int], attrs: Dict):
+        self.name = name
+        self.span_id = next(_ids)
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self._t0 = time.perf_counter()
+        self._wall = time.time()
+
+    def set_attr(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+
+class _NullSpan:
+    """The shared disabled-path object: every method is a no-op."""
+
+    __slots__ = ()
+    span_id = None
+    parent_id = None
+
+    def set_attr(self, key: str, value: Any) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Bounded in-memory span recorder with thread-local parent links."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, enabled: bool = True):
+        self.enabled = enabled
+        self._ring: Deque[Span] = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    # -- span lifecycle ----------------------------------------------------
+
+    def _stack(self) -> List[_ActiveSpan]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def start_span(self, name: str, **attrs: Any):
+        """Explicit API (use ``span()`` where a ``with`` block fits).
+        The new span's parent is this thread's innermost open span."""
+        if not self.enabled:
+            return _NULL_SPAN
+        stack = self._stack()
+        parent = stack[-1].span_id if stack else None
+        span = _ActiveSpan(name, parent, attrs)
+        stack.append(span)
+        return span
+
+    def end_span(self, span: _ActiveSpan) -> Optional[Span]:
+        if span is _NULL_SPAN:
+            return None
+        done = Span(
+            name=span.name,
+            span_id=span.span_id,
+            parent_id=span.parent_id,
+            start_s=span._wall,
+            duration_s=time.perf_counter() - span._t0,
+            thread_id=threading.get_ident(),
+            attrs=span.attrs,
+        )
+        stack = self._stack()
+        if span in stack:  # tolerate out-of-order ends
+            stack.remove(span)
+        with self._lock:
+            self._ring.append(done)
+        return done
+
+    @contextlib.contextmanager
+    def _span_cm(self, name: str, attrs: Dict[str, Any]):
+        span = self.start_span(name, **attrs)
+        try:
+            yield span
+        finally:
+            self.end_span(span)
+
+    def span(self, name: str, **attrs: Any):
+        """``with tracer.span("serving.dispatch", bucket=8):`` — records
+        nothing when the tracer is disabled."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return self._span_cm(name, attrs)
+
+    def current_span(self):
+        stack = getattr(self._local, "stack", None)
+        return stack[-1] if stack else _NULL_SPAN
+
+    # -- queries / export --------------------------------------------------
+
+    def recent(self, n: Optional[int] = None) -> List[Span]:
+        """Most recent finished spans, oldest first."""
+        with self._lock:
+            spans = list(self._ring)
+        return spans if n is None else spans[-n:]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """The ring as Chrome trace-event JSON (object format): one
+        complete ``"ph": "X"`` event per span, microsecond timestamps,
+        span/parent ids in ``args`` — loads in chrome://tracing and
+        Perfetto."""
+        pid = os.getpid()
+        events = []
+        for s in self.recent():
+            events.append(
+                {
+                    "name": s.name,
+                    "ph": "X",
+                    "ts": s.start_s * 1e6,
+                    "dur": s.duration_s * 1e6,
+                    "pid": pid,
+                    "tid": s.thread_id,
+                    "args": {
+                        **s.attrs,
+                        "span_id": s.span_id,
+                        "parent_id": s.parent_id,
+                    },
+                }
+            )
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path
+
+
+# -- process-global tracer -------------------------------------------------
+
+_global_tracer = Tracer(enabled=False)
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer (disabled until ``enable_tracing``)."""
+    return _global_tracer
+
+
+def enable_tracing(capacity: Optional[int] = None) -> Tracer:
+    if capacity is not None and capacity != _global_tracer._ring.maxlen:
+        _global_tracer._ring = collections.deque(
+            _global_tracer._ring, maxlen=capacity
+        )
+    _global_tracer.enabled = True
+    return _global_tracer
+
+
+def disable_tracing() -> None:
+    _global_tracer.enabled = False

@@ -392,8 +392,17 @@ class KernelRidgeRegression(LabelEstimator):
         return order
 
     def fit(self, data: Dataset, labels: Dataset) -> KernelBlockLinearMapper:
+        from keystone_tpu.utils.profiling import PhaseTimer
+
         if self.solve not in ("device", "host"):
             raise ValueError(f"solve must be 'device' or 'host', got {self.solve!r}")
+        # per-phase wall clock, published as registry metrics
+        # (keystone_phase_seconds_total{timer="krr_fit"}) — the
+        # scrapeable version of the reference's kernelGen/residual/
+        # localSolve/modelUpdate log lines (KernelRidgeRegression.scala:
+        # 213-221); device-path phases are enqueue time (dispatch is
+        # async), host-path phases include the blocking f64 solve
+        timer = PhaseTimer("krr_fit")
         data = data.to_array_mode()
         labels = labels.to_array_mode()
         transformer = self.kernel_generator.fit(data)
@@ -460,22 +469,24 @@ class KernelRidgeRegression(LabelEstimator):
                     self.num_epochs > 1
                     and cache_bytes <= 0.6 * _device_memory_limit()
                 )
-            if use_cached:
-                W = _krr_cached_epoch_scan(
-                    transformer.train_X, transformer._norms,
-                    transformer.gamma, transformer.train_mask,
-                    W, Y, jnp.asarray(order, jnp.int32), self.lam,
-                    width=width,
-                )
-            else:
-                all_starts = jnp.asarray(
-                    [blocks[i][0] for i in order], jnp.int32
-                )
-                W = _krr_epoch_scan(
-                    transformer.train_X, transformer._norms,
-                    transformer.gamma, transformer.train_mask,
-                    W, Y, all_starts, self.lam, width=width,
-                )
+            with timer.phase("epoch_scan"):
+                if use_cached:
+                    W = _krr_cached_epoch_scan(
+                        transformer.train_X, transformer._norms,
+                        transformer.gamma, transformer.train_mask,
+                        W, Y, jnp.asarray(order, jnp.int32), self.lam,
+                        width=width,
+                    )
+                else:
+                    all_starts = jnp.asarray(
+                        [blocks[i][0] for i in order], jnp.int32
+                    )
+                    W = _krr_epoch_scan(
+                        transformer.train_X, transformer._norms,
+                        transformer.gamma, transformer.train_mask,
+                        W, Y, all_starts, self.lam, width=width,
+                    )
+            timer.publish()
             return KernelBlockLinearMapper(
                 W, self.block_size, transformer, n
             )
@@ -505,24 +516,29 @@ class KernelRidgeRegression(LabelEstimator):
             if self.solve == "device":
                 # whole block update — kernel block, residual, solve,
                 # model scatter — stays in the async dispatch stream
-                W = _krr_block_step(
-                    transformer.train_X, transformer._norms,
-                    transformer.gamma, transformer.train_mask,
-                    W, Y, s, self.lam, width=wd,
-                )
+                with timer.phase("block_step"):
+                    W = _krr_block_step(
+                        transformer.train_X, transformer._norms,
+                        transformer.gamma, transformer.train_mask,
+                        W, Y, s, self.lam, width=wd,
+                    )
             else:
-                K_block = transformer.train_block(s, wd)  # (n_pad, b)
-                resid, K_bb = _krr_residual(K_block, W, s, width=wd)
-                Wb_old = jax.lax.dynamic_slice_in_dim(W, s, wd, axis=0)
-                y_b = jax.lax.dynamic_slice_in_dim(Y, s, wd, axis=0)
-                rhs = y_b - (resid - _f32_mm(K_bb.T, Wb_old))
+                with timer.phase("kernel_block"):
+                    K_block = transformer.train_block(s, wd)  # (n_pad, b)
+                with timer.phase("residual"):
+                    resid, K_bb = _krr_residual(K_block, W, s, width=wd)
+                    Wb_old = jax.lax.dynamic_slice_in_dim(W, s, wd, axis=0)
+                    y_b = jax.lax.dynamic_slice_in_dim(Y, s, wd, axis=0)
+                    rhs = y_b - (resid - _f32_mm(K_bb.T, Wb_old))
                 # pad rows inside the block: K_bb row/col is zero there,
                 # λI makes the system nonsingular, W stays 0 via rhs=0
-                Wb_new = jnp.asarray(
-                    psd_solve_host(K_bb, np.asarray(rhs), self.lam),
-                    jnp.float32,
-                )
-                W = _krr_update_model(W, Wb_new, s, width=wd)
+                with timer.phase("host_solve"):
+                    Wb_new = jnp.asarray(
+                        psd_solve_host(K_bb, np.asarray(rhs), self.lam),
+                        jnp.float32,
+                    )
+                with timer.phase("model_update"):
+                    W = _krr_update_model(W, Wb_new, s, width=wd)
             done += 1
             if ckpt is not None:
                 ckpt.tick(lambda: {
@@ -532,6 +548,7 @@ class KernelRidgeRegression(LabelEstimator):
                 self.block_callback(done)
         if ckpt is not None:
             ckpt.clear()
+        timer.publish()
 
         return KernelBlockLinearMapper(
             W, self.block_size, transformer, n

@@ -12,15 +12,29 @@ production inference engine:
   thread-safe queue that coalesces single-example ``submit()`` requests
   into the smallest covering bucket under a max-latency deadline.
 - ``ServingMetrics`` (metrics.py): per-bucket compile/dispatch counts,
-  queue depth, p50/p99 latency, examples/sec.
+  request-size histogram, queue depth, p50/p95/p99 latency, windowed
+  examples/sec — auto-registered into the process-global
+  ``MetricsRegistry`` (``keystone_tpu.observability``) so the admin
+  endpoint's ``/metrics`` scrapes every live engine.
+- ``suggest_buckets`` (autoscale.py): propose the k-bucket set that
+  minimizes expected padding waste over the observed request-size
+  histogram (the metrics-driven replacement for operator-chosen
+  buckets).
 
 Persistent-compile-cache setup lives in
 ``keystone_tpu.parallel.runtime.setup_compilation_cache`` (a restarted
 server warms from disk instead of recompiling).
 """
 
+from keystone_tpu.serving.autoscale import padding_waste, suggest_buckets
 from keystone_tpu.serving.batching import MicroBatcher
 from keystone_tpu.serving.engine import CompiledPipeline
 from keystone_tpu.serving.metrics import ServingMetrics
 
-__all__ = ["CompiledPipeline", "MicroBatcher", "ServingMetrics"]
+__all__ = [
+    "CompiledPipeline",
+    "MicroBatcher",
+    "ServingMetrics",
+    "padding_waste",
+    "suggest_buckets",
+]

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from keystone_tpu.observability.tracing import get_tracer
 from keystone_tpu.serving.engine import CompiledPipeline
 
 logger = logging.getLogger(__name__)
@@ -175,17 +176,24 @@ class MicroBatcher:
         futures = [f for _, f, _ in batch]
         enqueued = [t for _, _, t in batch]
         self.metrics.record_coalesce(len(batch))
+        # the engine's serving.dispatch span nests under this one, so
+        # /tracez shows coalesce -> dispatch parent links per window
         try:
-            def stack(*xs):
-                # host payloads stack on HOST: the whole window then
-                # crosses to the device as ONE transfer inside the
-                # engine, not one per example
-                if any(isinstance(x, jax.Array) for x in xs):
-                    return jnp.stack([jnp.asarray(x) for x in xs])
-                return np.stack([np.asarray(x) for x in xs])
+            with get_tracer().span(
+                "microbatch.coalesce",
+                engine=self.engine.name,
+                window=len(batch),
+            ):
+                def stack(*xs):
+                    # host payloads stack on HOST: the whole window then
+                    # crosses to the device as ONE transfer inside the
+                    # engine, not one per example
+                    if any(isinstance(x, jax.Array) for x in xs):
+                        return jnp.stack([jnp.asarray(x) for x in xs])
+                    return np.stack([np.asarray(x) for x in xs])
 
-            stacked = jax.tree_util.tree_map(stack, *examples)
-            out = self.engine.apply(stacked, sync=True, owned=True)
+                stacked = jax.tree_util.tree_map(stack, *examples)
+                out = self.engine.apply(stacked, sync=True, owned=True)
             done = time.perf_counter()
             for i, fut in enumerate(futures):
                 row = jax.tree_util.tree_map(lambda a, i=i: a[i], out)

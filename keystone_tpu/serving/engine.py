@@ -28,6 +28,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from keystone_tpu.observability.tracing import get_tracer
 from keystone_tpu.parallel import mesh as mesh_lib
 from keystone_tpu.parallel.dataset import Dataset, _leading_dim
 from keystone_tpu.serving.metrics import ServingMetrics
@@ -68,6 +69,7 @@ class CompiledPipeline:
         shard: bool = False,
         mesh=None,
         metrics: Optional[ServingMetrics] = None,
+        name: Optional[str] = None,
     ):
         if not buckets:
             raise ValueError("need at least one bucket")
@@ -83,6 +85,11 @@ class CompiledPipeline:
             buckets = [_round_up(b, nshards) for b in buckets]
         self.buckets: Tuple[int, ...] = tuple(sorted(set(int(b) for b in buckets)))
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        # every engine is scrapeable: its per-bucket compile/dispatch
+        # counters and latency quantiles export through the global
+        # MetricsRegistry (weakref bridge — registration never extends
+        # this engine's lifetime) under the `engine` label
+        self.name = self.metrics.register(engine=name)
         self.donate = donate and jax.default_backend() in ("tpu", "gpu")
         self._fns: Dict[int, Callable] = {}
         # a MicroBatcher worker and direct apply() callers may race to
@@ -215,13 +222,16 @@ class CompiledPipeline:
 
     def _dispatch(self, chunk: Any, rows: int, owned: bool = False) -> Any:
         bucket = self.bucket_for(rows)
-        t0 = time.perf_counter()
-        staged = self._stage(chunk, rows, bucket, owned=owned)
-        out = self._fn(bucket)(staged)
-        valid = jax.tree_util.tree_map(lambda a: a[:rows], out)
-        self.metrics.record_dispatch(
-            bucket, rows, time.perf_counter() - t0
-        )
+        with get_tracer().span(
+            "serving.dispatch", engine=self.name, bucket=bucket, rows=rows
+        ):
+            t0 = time.perf_counter()
+            staged = self._stage(chunk, rows, bucket, owned=owned)
+            out = self._fn(bucket)(staged)
+            valid = jax.tree_util.tree_map(lambda a: a[:rows], out)
+            self.metrics.record_dispatch(
+                bucket, rows, time.perf_counter() - t0
+            )
         return valid
 
     def warmup(

@@ -3,21 +3,35 @@
 One ``ServingMetrics`` instance rides along with each engine (and is
 shared with its ``MicroBatcher``): per-bucket XLA compile counts — the
 number the bucketed design exists to bound — per-bucket dispatch
-counts, padded-vs-valid example counts (padding waste), dispatch and
-end-to-end request latency percentiles, and a queue-depth gauge.
+counts, padded-vs-valid example counts (padding waste), the observed
+per-request size histogram (what the bucket autoscaler reads), dispatch
+and end-to-end request latency percentiles, and a queue-depth gauge.
 
 Built on the generic ``Counter`` / ``LatencyRecorder`` primitives in
 ``utils/profiling.py`` so the same machinery serves training-side
-instrumentation.
+instrumentation — and bridged into the process-global
+``MetricsRegistry`` (``register()``; ``CompiledPipeline`` does this on
+construction) so the admin endpoint's ``/metrics`` exports every
+engine's counters under an ``engine`` label. The bridge holds only a
+weakref: an engine going out of scope unregisters itself at the next
+scrape.
 """
 
 from __future__ import annotations
 
+import collections
+import itertools
 import threading
 import time
-from typing import Dict, Optional
+import weakref
+from typing import Deque, Dict, Optional, Tuple
 
 from keystone_tpu.utils.profiling import Counter, LatencyRecorder
+
+# default sliding window of the instantaneous throughput gauge
+RATE_WINDOW_S = 30.0
+
+_engine_ids = itertools.count()
 
 
 class ServingMetrics:
@@ -29,6 +43,9 @@ class ServingMetrics:
         # valid examples served / padded rows shipped (waste tracking)
         self.examples = Counter()
         self.padded_rows = Counter()
+        # valid-row count of each dispatch (the observed request-size
+        # histogram serving/autoscale.py proposes bucket sets from)
+        self.request_sizes = Counter()
         # wall time of engine dispatches: pad/placement + compiled-call
         # ENQUEUE (execution is async; apply(sync=True) blocks once at
         # the end, outside this number), plus trace+compile on a
@@ -40,6 +57,10 @@ class ServingMetrics:
         self.request_latency = LatencyRecorder(latency_window)
         self._queue_depth = 0
         self._coalesced_max = 0
+        # (timestamp, examples) per dispatch, pruned to the rate window:
+        # the windowed examples/sec gauge reads this, so idle periods
+        # decay to zero instead of diluting a lifetime average
+        self._rate_events: Deque[Tuple[float, int]] = collections.deque()
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
 
@@ -54,7 +75,14 @@ class ServingMetrics:
         self.dispatches.inc(bucket)
         self.examples.inc(None, n_valid)
         self.padded_rows.inc(None, bucket - n_valid)
+        self.request_sizes.inc(n_valid)
         self.dispatch_latency.record(seconds)
+        now = time.perf_counter()
+        with self._lock:
+            self._rate_events.append((now, n_valid))
+            cutoff = now - RATE_WINDOW_S
+            while self._rate_events and self._rate_events[0][0] < cutoff:
+                self._rate_events.popleft()
 
     # -- batcher-side hooks ------------------------------------------------
 
@@ -85,7 +113,25 @@ class ServingMetrics:
         with self._lock:
             return self._coalesced_max
 
-    def examples_per_sec(self) -> float:
+    def examples_per_sec(self, window: float = RATE_WINDOW_S) -> float:
+        """Windowed throughput: examples dispatched over the last
+        ``window`` seconds (clamped to the instance's lifetime so a
+        young engine isn't over-divided, and to ``RATE_WINDOW_S`` —
+        events older than that are pruned at record time, so a larger
+        window would silently divide a 30s sum by more than 30s). This
+        is the gauge ``summary()`` and ``/metrics`` export — unlike the
+        lifetime average it goes to zero when traffic stops instead of
+        decaying slowly forever."""
+        now = time.perf_counter()
+        window = min(window, RATE_WINDOW_S, max(now - self._t0, 1e-9))
+        cutoff = now - window
+        with self._lock:
+            served = sum(
+                n for t, n in self._rate_events if t >= cutoff
+            )
+        return served / window
+
+    def examples_per_sec_lifetime(self) -> float:
         """LIFETIME average (examples since construction / wall time
         since construction) — it decays over idle periods and includes
         warmup, so it's a capacity sanity number, not an instantaneous
@@ -100,6 +146,8 @@ class ServingMetrics:
         def ms(v: Optional[float]) -> Optional[float]:
             return round(v * 1e3, 3) if v is not None else None
 
+        dispatch = self.dispatch_latency.snapshot()
+        request = self.request_latency.snapshot()
         return {
             "compiles_per_bucket": {
                 str(k): v for k, v in sorted(self.compiles.snapshot().items())
@@ -110,11 +158,139 @@ class ServingMetrics:
             },
             "examples": self.examples.total,
             "padded_rows": self.padded_rows.total,
-            "examples_per_sec_lifetime": round(self.examples_per_sec(), 1),
-            "dispatch_p50_ms": ms(self.dispatch_latency.p50),
-            "dispatch_p99_ms": ms(self.dispatch_latency.p99),
-            "request_p50_ms": ms(self.request_latency.p50),
-            "request_p99_ms": ms(self.request_latency.p99),
+            "examples_per_sec": round(self.examples_per_sec(), 1),
+            "examples_per_sec_lifetime": round(
+                self.examples_per_sec_lifetime(), 1
+            ),
+            "dispatch_p50_ms": ms(dispatch["p50"]),
+            "dispatch_p95_ms": ms(dispatch["p95"]),
+            "dispatch_p99_ms": ms(dispatch["p99"]),
+            "request_p50_ms": ms(request["p50"]),
+            "request_p95_ms": ms(request["p95"]),
+            "request_p99_ms": ms(request["p99"]),
             "queue_depth": self.queue_depth,
             "max_coalesced": self.max_coalesced,
         }
+
+    # -- MetricsRegistry bridge --------------------------------------------
+
+    def register(self, registry=None, engine: Optional[str] = None) -> str:
+        """Export this instance's live state through a ``MetricsRegistry``
+        (the process-global one by default) under an ``engine`` label.
+
+        Registers a weakref-holding collector: nothing is copied until a
+        scrape, the hot-path record_* methods are untouched, and once
+        the engine (and its metrics) are garbage-collected the collector
+        returns None and is pruned. Returns the engine label used.
+
+        Idempotent against the global registry: a second global
+        ``register()`` (e.g. an engine wrapping caller-provided metrics
+        that already registered) returns the existing label instead of
+        double-exporting every family.
+
+        Label ownership: registering a label that a still-live
+        ``ServingMetrics`` already claimed in the same registry
+        TRANSFERS it — the newest registration wins and the superseded
+        collector prunes itself at the next scrape. That keeps the
+        documented engine-swap loop (build replacement under the same
+        name, warm, swap) from ever emitting duplicate series, which
+        Prometheus rejects scrape-wide."""
+        from keystone_tpu.observability.registry import (
+            MetricFamily,
+            Sample,
+            get_global_registry,
+        )
+
+        if registry is None and getattr(self, "_registered_label", None):
+            return self._registered_label
+        reg = registry if registry is not None else get_global_registry()
+        label = engine if engine is not None else f"engine{next(_engine_ids)}"
+        if registry is None:
+            self._registered_label = label
+        ref = weakref.ref(self)
+        # per-registry label claim table: collector emits only while it
+        # is the label's CURRENT owner
+        claims = getattr(reg, "_engine_label_claims", None)
+        if claims is None:
+            claims = reg._engine_label_claims = {}
+        claims[label] = ref
+
+        def quantile_samples(rec: LatencyRecorder):
+            snap = rec.snapshot()
+            out = [
+                Sample(
+                    "",
+                    {"engine": label, "quantile": repr(q)},
+                    snap[f"p{int(q * 100)}"],
+                )
+                for q in (0.5, 0.95, 0.99)
+                if snap[f"p{int(q * 100)}"] is not None
+            ]
+            out.append(Sample("_count", {"engine": label}, snap["count"]))
+            out.append(Sample("_sum", {"engine": label}, snap["total"]))
+            return out
+
+        def collect():
+            m = ref()
+            if m is None or claims.get(label) is not ref:
+                return None  # engine gone or label re-claimed by a
+                # newer engine: prune this collector
+            return [
+                MetricFamily(
+                    "keystone_serving_compiles_total", "counter",
+                    "XLA compiles per bucket",
+                    [
+                        Sample("", {"engine": label, "bucket": str(b)}, v)
+                        for b, v in sorted(m.compiles.snapshot().items())
+                    ],
+                ),
+                MetricFamily(
+                    "keystone_serving_dispatches_total", "counter",
+                    "compiled-program dispatches per bucket",
+                    [
+                        Sample("", {"engine": label, "bucket": str(b)}, v)
+                        for b, v in sorted(m.dispatches.snapshot().items())
+                    ],
+                ),
+                MetricFamily(
+                    "keystone_serving_examples_total", "counter",
+                    "valid examples served",
+                    [Sample("", {"engine": label}, m.examples.total)],
+                ),
+                MetricFamily(
+                    "keystone_serving_padded_rows_total", "counter",
+                    "padded rows shipped (bucket waste)",
+                    [Sample("", {"engine": label}, m.padded_rows.total)],
+                ),
+                MetricFamily(
+                    "keystone_serving_request_size_total", "counter",
+                    "dispatches by valid-row count (autoscaler input)",
+                    [
+                        Sample("", {"engine": label, "size": str(s)}, v)
+                        for s, v in sorted(m.request_sizes.snapshot().items())
+                    ],
+                ),
+                MetricFamily(
+                    "keystone_serving_queue_depth", "gauge",
+                    "micro-batcher pending requests",
+                    [Sample("", {"engine": label}, m.queue_depth)],
+                ),
+                MetricFamily(
+                    "keystone_serving_examples_per_sec", "gauge",
+                    f"windowed throughput over the last {RATE_WINDOW_S:.0f}s",
+                    [Sample("", {"engine": label}, m.examples_per_sec())],
+                ),
+                MetricFamily(
+                    "keystone_serving_dispatch_latency_seconds", "summary",
+                    "engine dispatch wall time",
+                    quantile_samples(m.dispatch_latency),
+                ),
+                MetricFamily(
+                    "keystone_serving_request_latency_seconds", "summary",
+                    "end-to-end micro-batched request latency",
+                    quantile_samples(m.request_latency),
+                ),
+            ]
+
+        reg.register_collector(collect)
+        return label

@@ -10,8 +10,8 @@ TPU equivalents here:
   XPlane traces viewable in TensorBoard/XProf (the substrate-level trace
   the reference lacked).
 - ``PhaseTimer``: the per-phase wall-clock logger.
-- ``instrument_executor``: monkey-patches a GraphExecutor to record
-  per-node execution wall time (the interpret-layer profile).
+- ``instrument_executor``: hooks a GraphExecutor's per-node timing
+  callback to record execution wall time (the interpret-layer profile).
 - DOT export lives on the Graph itself (``Graph.to_dot``), same as the
   reference's toDOTString.
 """
@@ -47,6 +47,7 @@ class PhaseTimer:
     def __init__(self, name: str = ""):
         self.name = name
         self.times: Dict[str, float] = {}
+        self._published: Dict[str, float] = {}
 
     @contextlib.contextmanager
     def phase(self, phase_name: str) -> Iterator[None]:
@@ -64,6 +65,27 @@ class PhaseTimer:
 
     def log(self) -> None:
         logger.info(self.summary())
+
+    def publish(self, registry=None) -> None:
+        """Publish accumulated phase times into a ``MetricsRegistry``
+        (the global one by default) as
+        ``keystone_phase_seconds_total{timer=..., phase=...}`` — how
+        solver/profiler phase logs become scrapeable instead of
+        stdout-only. Publishes only the delta since the last publish,
+        so periodic calls from a long fit never double-count."""
+        from keystone_tpu.observability.registry import get_global_registry
+
+        reg = registry if registry is not None else get_global_registry()
+        counter = reg.counter(
+            "keystone_phase_seconds_total",
+            "accumulated wall seconds per named phase",
+            labelnames=("timer", "phase"),
+        )
+        for phase_name, seconds in self.times.items():
+            delta = seconds - self._published.get(phase_name, 0.0)
+            if delta > 0:
+                counter.inc((self.name or "phase_timer", phase_name), delta)
+                self._published[phase_name] = seconds
 
 
 class LatencyRecorder:
@@ -105,6 +127,10 @@ class LatencyRecorder:
         return self.percentile(50.0)
 
     @property
+    def p95(self) -> Optional[float]:
+        return self.percentile(95.0)
+
+    @property
     def p99(self) -> Optional[float]:
         return self.percentile(99.0)
 
@@ -112,6 +138,33 @@ class LatencyRecorder:
     def mean(self) -> Optional[float]:
         with self._lock:
             return self.total / self.count if self.count else None
+
+    def snapshot(self) -> Dict[str, Optional[float]]:
+        """count/total/p50/p95/p99 under ONE lock acquisition — a
+        mutually consistent view (separate property reads can straddle
+        concurrent records; exporters and ``ServingMetrics.summary()``
+        use this)."""
+        with self._lock:
+            count = self.count
+            total = self.total
+            data = sorted(self._samples)
+
+        def pct(p: float) -> Optional[float]:
+            if not data:
+                return None
+            rank = (p / 100.0) * (len(data) - 1)
+            lo = int(rank)
+            hi = min(lo + 1, len(data) - 1)
+            frac = rank - lo
+            return data[lo] * (1.0 - frac) + data[hi] * frac
+
+        return {
+            "count": count,
+            "total": total,
+            "p50": pct(50.0),
+            "p95": pct(95.0),
+            "p99": pct(99.0),
+        }
 
 
 class Counter:
@@ -141,18 +194,14 @@ class Counter:
 
 
 def instrument_executor(executor) -> Dict:
-    """Wraps a GraphExecutor's execute() to record per-node wall time.
-    Returns the (live) dict of node -> seconds."""
+    """Record per-node wall time on a GraphExecutor via its ``node_hook``
+    (workflow/executor.py) — no monkey-patching; the hook also powers
+    ``/tracez`` node spans. Returns the (live) dict of node -> seconds,
+    accumulated as nodes execute."""
     times: Dict = {}
-    original = executor.execute
 
-    def timed_execute(graph_id):
-        t0 = time.perf_counter()
-        out = original(graph_id)
-        times[graph_id] = times.get(graph_id, 0.0) + (
-            time.perf_counter() - t0
-        )
-        return out
+    def hook(graph_id, label, seconds):
+        times[graph_id] = times.get(graph_id, 0.0) + seconds
 
-    executor.execute = timed_execute
+    executor.node_hook = hook
     return times

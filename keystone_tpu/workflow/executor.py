@@ -8,8 +8,10 @@ save executed prefixes into the global state) and workflow/PipelineEnv.scala
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Set, Tuple
+import time
+from typing import Callable, Dict, Optional, Set, Tuple
 
+from keystone_tpu.observability.tracing import get_tracer
 from keystone_tpu.workflow.expressions import Expression
 from keystone_tpu.workflow.graph import (
     Graph,
@@ -208,14 +210,31 @@ class GraphExecutor:
     ``optimize=True`` runs the environment's optimizer once, lazily, before
     the first execution. Ids with a source ancestor cannot be executed (their
     value depends on unspliced runtime data).
+
+    Observability: ``node_hook`` is an optional
+    ``callable(node_id, label, seconds)`` invoked with each node's own
+    operator-execution wall time (excluding dependency time) the first
+    time the node runs — ``utils.profiling.instrument_executor`` sets it.
+    Independently, when the process-global tracer
+    (``observability.tracing``) is enabled, every first-time node
+    evaluation records a ``node:<label>`` span whose parent is the span
+    of the consumer that demanded it, so ``/tracez`` shows the executed
+    DAG as a span tree. Both are off by default and cost one attribute
+    check per node when off.
     """
 
-    def __init__(self, graph: Graph, optimize: bool = True):
+    def __init__(
+        self,
+        graph: Graph,
+        optimize: bool = True,
+        node_hook: Optional[Callable[[GraphId, str, float], None]] = None,
+    ):
         self._raw_graph = graph
         self._optimize = optimize
         self._optimized: Optional[Tuple[Graph, Dict[NodeId, Prefix]]] = None
         self._execution_state: Dict[GraphId, Expression] = {}
         self._source_dependants: Optional[Set[GraphId]] = None
+        self.node_hook = node_hook
 
     @property
     def raw_graph(self) -> Graph:
@@ -264,12 +283,36 @@ class GraphExecutor:
         if isinstance(graph_id, SinkId):
             expr = self.execute(g.sink_dependencies[graph_id])
         else:
-            dep_exprs = [self.execute(d) for d in g.dependencies[graph_id]]
-            expr = g.operators[graph_id].execute(dep_exprs)
+            tracer = get_tracer()
+            if tracer.enabled or self.node_hook is not None:
+                expr = self._execute_instrumented(graph_id, g, tracer)
+            else:
+                dep_exprs = [
+                    self.execute(d) for d in g.dependencies[graph_id]
+                ]
+                expr = g.operators[graph_id].execute(dep_exprs)
             # Cross-pipeline prefix memoization (GraphExecutor.scala:68-70):
             # expose this node's expression under its structural prefix.
             prefix = prefixes.get(graph_id)
             if prefix is not None:
                 PipelineEnv.get_or_create().state.setdefault(prefix, expr)
         self._execution_state[graph_id] = expr
+        return expr
+
+    def _execute_instrumented(self, graph_id, g, tracer) -> Expression:
+        """First-time node evaluation with a ``node:<label>`` span around
+        the whole demand (so dependency spans nest under their consumer,
+        mirroring the executed DAG in ``/tracez``) and the node's OWN
+        operator wall time — dependencies excluded — reported to
+        ``node_hook`` and stamped on the span."""
+        op = g.operators[graph_id]
+        label = getattr(op, "label", type(op).__name__)
+        with tracer.span(f"node:{label}", node_id=str(graph_id)) as span:
+            dep_exprs = [self.execute(d) for d in g.dependencies[graph_id]]
+            t0 = time.perf_counter()
+            expr = op.execute(dep_exprs)
+            self_seconds = time.perf_counter() - t0
+            span.set_attr("self_ms", round(self_seconds * 1e3, 6))
+        if self.node_hook is not None:
+            self.node_hook(graph_id, label, self_seconds)
         return expr

@@ -1,0 +1,193 @@
+"""Admin endpoint end-to-end (ephemeral port, fast) + stack-wide
+integration: a live engine's counters in /metrics, executor node spans
+in /tracez with parent links, Chrome trace export of a serving run.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from keystone_tpu.observability import (
+    AdminServer,
+    MetricsRegistry,
+    Tracer,
+    disable_tracing,
+    enable_tracing,
+    get_tracer,
+)
+
+
+def _get(server, path):
+    with urllib.request.urlopen(server.url(path), timeout=10) as resp:
+        return resp.status, resp.headers, resp.read().decode("utf-8")
+
+
+@pytest.fixture
+def traced():
+    tracer = enable_tracing()
+    tracer.clear()
+    yield tracer
+    disable_tracing()
+    tracer.clear()
+
+
+def test_healthz_and_404():
+    with AdminServer(registry=MetricsRegistry(), tracer=Tracer()) as srv:
+        status, _, body = _get(srv, "/healthz")
+        assert status == 200 and body == "ok\n"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv, "/nope")
+        assert e.value.code == 404
+
+
+def test_metrics_scrape_content_type_and_body():
+    reg = MetricsRegistry()
+    reg.counter("hits_total", "hits", ("path",)).inc(("/x",), by=3)
+    with AdminServer(registry=reg, tracer=Tracer()) as srv:
+        status, headers, body = _get(srv, "/metrics")
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain; version=0.0.4")
+    assert 'hits_total{path="/x"} 3' in body
+
+
+def test_varz_json():
+    reg = MetricsRegistry()
+    reg.gauge("depth").set(2)
+    with AdminServer(registry=reg, tracer=Tracer()) as srv:
+        _, headers, body = _get(srv, "/varz")
+    assert headers["Content-Type"].startswith("application/json")
+    doc = json.loads(body)
+    assert doc["depth"]["values"][0]["value"] == 2.0
+
+
+def test_live_engine_scrape_end_to_end(traced):
+    """Acceptance: GET /metrics on a live engine returns Prometheus text
+    with per-bucket compile/dispatch counters and latency quantiles;
+    /tracez shows the dispatch spans."""
+    from keystone_tpu.serving.bench import build_pipeline
+
+    reg = MetricsRegistry()
+    fitted = build_pipeline(d=8, hidden=8, depth=2)
+    engine = fitted.compiled(buckets=(4, 8))
+    label = engine.metrics.register(registry=reg, engine="test-engine")
+    assert label == "test-engine"
+    rng = np.random.default_rng(0)
+    engine.apply(rng.standard_normal((3, 8)).astype(np.float32), sync=True)
+    engine.apply(rng.standard_normal((7, 8)).astype(np.float32), sync=True)
+
+    with AdminServer(registry=reg, tracer=get_tracer()) as srv:
+        _, _, metrics = _get(srv, "/metrics")
+        _, _, tracez = _get(srv, "/tracez")
+        _, _, healthz = _get(srv, "/healthz")
+
+    assert healthz == "ok\n"
+    want = [
+        'keystone_serving_compiles_total{engine="test-engine",bucket="4"} 1',
+        'keystone_serving_compiles_total{engine="test-engine",bucket="8"} 1',
+        'keystone_serving_dispatches_total{engine="test-engine",bucket="4"} 1',
+        'keystone_serving_dispatches_total{engine="test-engine",bucket="8"} 1',
+        'keystone_serving_request_size_total{engine="test-engine",size="3"} 1',
+        'keystone_serving_dispatch_latency_seconds{engine="test-engine",'
+        'quantile="0.5"}',
+        'keystone_serving_dispatch_latency_seconds{engine="test-engine",'
+        'quantile="0.99"}',
+        'keystone_serving_dispatch_latency_seconds_count'
+        '{engine="test-engine"} 2',
+        'keystone_serving_examples_total{engine="test-engine"} 10',
+    ]
+    for line in want:
+        assert line in metrics, f"missing {line!r} in:\n{metrics}"
+
+    spans = json.loads(tracez)["spans"]
+    dispatches = [s for s in spans if s["name"] == "serving.dispatch"]
+    assert len(dispatches) == 2
+    assert {d["attrs"]["bucket"] for d in dispatches} == {4, 8}
+
+
+def test_executor_node_spans_in_tracez_with_parent_links(traced, mesh8):
+    """Acceptance: workflow executor node spans appear in /tracez with
+    parent links (the consumer that demanded a node is its parent)."""
+    from keystone_tpu.ops.stats import LinearRectifier, NormalizeRows
+
+    pipe = LinearRectifier(0.0).and_then(NormalizeRows())
+    pipe.apply(np.ones((4, 3), np.float32)).get()
+
+    with AdminServer(registry=MetricsRegistry(), tracer=get_tracer()) as srv:
+        _, _, body = _get(srv, "/tracez")
+    doc = json.loads(body)
+    assert doc["enabled"] is True
+    nodes = [s for s in doc["spans"] if s["name"].startswith("node:")]
+    assert len(nodes) >= 2
+    by_id = {s["span_id"]: s for s in nodes}
+    linked = [
+        s for s in nodes
+        if s["parent_id"] is not None and s["parent_id"] in by_id
+    ]
+    assert linked, f"want node->node parent links, got {nodes}"
+    # every node span carries its own wall time
+    assert all("self_ms" in s["attrs"] for s in nodes)
+
+
+def test_chrome_trace_export_of_serving_run(traced, tmp_path):
+    """Acceptance: a recorded serving run exports Chrome trace JSON
+    that is structurally loadable (traceEvents of complete "X" events
+    with numeric ts/dur) — the chrome://tracing / Perfetto format."""
+    from keystone_tpu.serving import MicroBatcher
+    from keystone_tpu.serving.bench import build_pipeline
+
+    fitted = build_pipeline(d=8, hidden=8, depth=2)
+    engine = fitted.compiled(buckets=(4,))
+    engine.warmup(example=np.zeros((8,), np.float32))
+    with MicroBatcher(engine, max_delay_ms=1.0) as mb:
+        futs = [
+            mb.submit(np.ones((8,), np.float32)) for _ in range(3)
+        ]
+        for f in futs:
+            f.result(timeout=30)
+
+    path = str(tmp_path / "serving_trace.json")
+    get_tracer().export_chrome_trace(path)
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    assert events, "serving run recorded no spans"
+    assert all(e["ph"] == "X" for e in events)
+    assert all(
+        isinstance(e["ts"], (int, float))
+        and isinstance(e["dur"], (int, float))
+        for e in events
+    )
+    names = {e["name"] for e in events}
+    assert "serving.dispatch" in names
+    assert "microbatch.coalesce" in names
+    # the dispatch span parents under its coalesce window
+    coalesce_ids = {
+        e["args"]["span_id"]
+        for e in events
+        if e["name"] == "microbatch.coalesce"
+    }
+    dispatch_parents = {
+        e["args"]["parent_id"]
+        for e in events
+        if e["name"] == "serving.dispatch"
+    }
+    assert dispatch_parents & coalesce_ids
+
+    # /tracez?format=chrome serves the same document
+    with AdminServer(registry=MetricsRegistry(), tracer=get_tracer()) as srv:
+        _, _, body = _get(srv, "/tracez?format=chrome")
+    assert {e["name"] for e in json.loads(body)["traceEvents"]} == names
+
+
+def test_disabled_admin_means_no_server_and_no_spans():
+    """The whole plane is off by default: the global tracer records
+    nothing and engine construction alone opens no sockets (nothing to
+    assert beyond: tracer off, span() is the null object)."""
+    tracer = get_tracer()
+    assert not tracer.enabled
+    before = len(tracer.recent())
+    with tracer.span("ghost"):
+        pass
+    assert len(tracer.recent()) == before

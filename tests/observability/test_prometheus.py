@@ -1,0 +1,140 @@
+"""Prometheus text exposition v0.0.4 golden-string tests — no sockets.
+
+The scrape contract: metric-name sanitization, label-value escaping
+(backslash, quote, newline), counter/gauge/summary line formats.
+"""
+
+from keystone_tpu.observability.prometheus import (
+    escape_help,
+    escape_label_value,
+    format_value,
+    render,
+    render_family,
+    sanitize_label_name,
+    sanitize_metric_name,
+)
+from keystone_tpu.observability.registry import (
+    MetricFamily,
+    MetricsRegistry,
+    Sample,
+)
+
+
+def test_metric_name_sanitization():
+    assert sanitize_metric_name("my.metric-name") == "my_metric_name"
+    assert sanitize_metric_name("ok_name:sub") == "ok_name:sub"
+    assert sanitize_metric_name("0starts_with_digit") == "_0starts_with_digit"
+    assert sanitize_metric_name("sp ace/slash") == "sp_ace_slash"
+
+
+def test_label_name_sanitization():
+    assert sanitize_label_name("a.b") == "a_b"
+    assert sanitize_label_name("with:colon") == "with_colon"  # no colons
+    assert sanitize_label_name("9lead") == "_9lead"
+
+
+def test_label_value_escaping():
+    assert escape_label_value('plain') == 'plain'
+    assert escape_label_value('say "hi"') == r'say \"hi\"'
+    assert escape_label_value('line1\nline2') == r'line1\nline2'
+    assert escape_label_value('back\\slash') == 'back\\\\slash'
+    # backslash escapes first: a literal `\n` (two chars) round-trips
+    # distinctly from a newline
+    assert escape_label_value('\\n') == r'\\n'
+    assert escape_label_value('\n') == r'\n'
+
+
+def test_help_escaping():
+    assert escape_help('multi\nline \\ "quoted"') == (
+        r'multi\nline \\ "quoted"'
+    )
+
+
+def test_format_value():
+    assert format_value(3) == "3"
+    assert format_value(3.0) == "3"
+    assert format_value(0.25) == "0.25"
+    assert format_value(float("inf")) == "+Inf"
+    assert format_value(float("-inf")) == "-Inf"
+    assert format_value(float("nan")) == "NaN"
+
+
+def test_counter_family_golden():
+    fam = MetricFamily(
+        "keystone_serving_compiles_total", "counter",
+        "XLA compiles per bucket",
+        [
+            Sample("", {"engine": "e0", "bucket": "8"}, 1),
+            Sample("", {"engine": "e0", "bucket": "64"}, 2),
+        ],
+    )
+    assert render_family(fam) == (
+        "# HELP keystone_serving_compiles_total XLA compiles per bucket\n"
+        "# TYPE keystone_serving_compiles_total counter\n"
+        'keystone_serving_compiles_total{engine="e0",bucket="8"} 1\n'
+        'keystone_serving_compiles_total{engine="e0",bucket="64"} 2\n'
+    )
+
+
+def test_gauge_no_labels_golden():
+    fam = MetricFamily("queue_depth", "gauge", "", [Sample("", {}, 5)])
+    assert render_family(fam) == (
+        "# TYPE queue_depth gauge\n"
+        "queue_depth 5\n"
+    )
+
+
+def test_summary_family_golden():
+    fam = MetricFamily(
+        "req_latency_seconds", "summary", "request latency",
+        [
+            Sample("", {"quantile": "0.5"}, 0.25),
+            Sample("", {"quantile": "0.99"}, 0.5),
+            Sample("_count", {}, 4),
+            Sample("_sum", {}, 1.5),
+        ],
+    )
+    assert render_family(fam) == (
+        "# HELP req_latency_seconds request latency\n"
+        "# TYPE req_latency_seconds summary\n"
+        'req_latency_seconds{quantile="0.5"} 0.25\n'
+        'req_latency_seconds{quantile="0.99"} 0.5\n'
+        "req_latency_seconds_count 4\n"
+        "req_latency_seconds_sum 1.5\n"
+    )
+
+
+def test_hostile_label_values_golden():
+    fam = MetricFamily(
+        "evil_total", "counter", "",
+        [Sample("", {"path": 'a\\b\n"c"'}, 1)],
+    )
+    assert render_family(fam) == (
+        "# TYPE evil_total counter\n"
+        'evil_total{path="a\\\\b\\n\\"c\\""} 1\n'
+    )
+
+
+def test_render_full_registry_sorted_with_trailing_newline():
+    reg = MetricsRegistry()
+    reg.counter("z_total", "zs").inc()
+    reg.gauge("a_gauge", "the a").set(1.5)
+    body = render(reg.collect())
+    assert body == (
+        "# HELP a_gauge the a\n"
+        "# TYPE a_gauge gauge\n"
+        "a_gauge 1.5\n"
+        "# HELP z_total zs\n"
+        "# TYPE z_total counter\n"
+        "z_total 1\n"
+    )
+    assert body.endswith("\n")
+
+
+def test_invalid_name_sanitized_in_render():
+    fam = MetricFamily(
+        "bad.name-here", "counter", "", [Sample("", {"l.x": "v"}, 1)]
+    )
+    out = render_family(fam)
+    assert "bad_name_here" in out
+    assert 'l_x="v"' in out

@@ -1,0 +1,168 @@
+"""MetricsRegistry: named/labeled metrics, collectors, global plane."""
+
+import gc
+import threading
+
+import pytest
+
+from keystone_tpu.observability.registry import (
+    MetricFamily,
+    MetricsRegistry,
+    Sample,
+    get_global_registry,
+)
+
+
+def test_counter_labels_and_total():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs_total", "requests", labelnames=("bucket",))
+    c.inc(("8",))
+    c.inc(("8",), by=2)
+    c.inc(("64",))
+    assert c.get(("8",)) == 3
+    assert c.get(("64",)) == 1
+    fam = c.collect()
+    assert fam.mtype == "counter"
+    assert {tuple(s.labels.items()): s.value for s in fam.samples} == {
+        (("bucket", "8"),): 3,
+        (("bucket", "64"),): 1,
+    }
+
+
+def test_counter_rejects_decrease_and_bad_labels():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", labelnames=("a",))
+    with pytest.raises(ValueError):
+        c.inc(("x",), by=-1)
+    with pytest.raises(ValueError):
+        c.inc()  # missing label value
+    with pytest.raises(ValueError):
+        c.inc(("x", "y"))  # too many
+
+
+def test_reregistration_is_idempotent_but_type_mismatch_raises():
+    reg = MetricsRegistry()
+    c1 = reg.counter("shared_total", "h", labelnames=("l",))
+    c2 = reg.counter("shared_total", "h", labelnames=("l",))
+    assert c1 is c2
+    with pytest.raises(ValueError):
+        reg.gauge("shared_total")
+    with pytest.raises(ValueError):
+        reg.counter("shared_total", labelnames=("other",))
+
+
+def test_gauge_set_and_func_gauge():
+    reg = MetricsRegistry()
+    g = reg.gauge("depth", labelnames=("engine",))
+    g.set(3, ("e0",))
+    g.set(5.5, ("e1",))
+    vals = {s.labels["engine"]: s.value for s in g.collect().samples}
+    assert vals == {"e0": 3.0, "e1": 5.5}
+
+    state = {"v": 7.0}
+    reg.gauge_func("live", lambda: state["v"])
+    fam = [f for f in reg.collect() if f.name == "live"][0]
+    assert fam.samples[0].value == 7.0
+    state["v"] = 9.0
+    fam = [f for f in reg.collect() if f.name == "live"][0]
+    assert fam.samples[0].value == 9.0  # polled at collect time
+
+
+def test_func_gauge_labeled_dict():
+    reg = MetricsRegistry()
+    reg.gauge_func(
+        "per_bucket", lambda: {("8",): 1.0, ("64",): 2.0},
+        labelnames=("bucket",),
+    )
+    fam = reg.collect()[0]
+    assert {s.labels["bucket"]: s.value for s in fam.samples} == {
+        "8": 1.0, "64": 2.0,
+    }
+
+
+def test_summary_quantiles_count_sum():
+    reg = MetricsRegistry()
+    s = reg.summary("lat_seconds", labelnames=("engine",))
+    for v in [0.010, 0.020, 0.030, 0.040]:
+        s.observe(v, ("e0",))
+    fam = s.collect()
+    assert fam.mtype == "summary"
+    by_suffix = {}
+    for sample in fam.samples:
+        by_suffix.setdefault(sample.suffix, []).append(sample)
+    assert by_suffix["_count"][0].value == 4
+    assert by_suffix["_sum"][0].value == pytest.approx(0.1)
+    quantiles = {s.labels["quantile"] for s in by_suffix[""]}
+    assert quantiles == {"0.5", "0.95", "0.99"}
+
+
+def test_collector_callback_and_weakref_prune():
+    reg = MetricsRegistry()
+
+    class Owner:
+        pass
+
+    owner = Owner()
+    import weakref
+
+    ref = weakref.ref(owner)
+
+    def collect():
+        if ref() is None:
+            return None
+        return [
+            MetricFamily("owned_total", "counter", "", [Sample("", {}, 1)])
+        ]
+
+    reg.register_collector(collect)
+    assert any(f.name == "owned_total" for f in reg.collect())
+    del owner
+    gc.collect()
+    assert not any(f.name == "owned_total" for f in reg.collect())
+    # pruned: the dead collector is gone from the registry entirely
+    assert reg._collectors == []
+
+
+def test_collect_merges_same_name_families():
+    """Two collectors exporting the same family name (two engines) get
+    one merged family, so exposition has a single TYPE block."""
+    reg = MetricsRegistry()
+    for label in ("a", "b"):
+        reg.register_collector(
+            lambda label=label: [
+                MetricFamily(
+                    "x_total", "counter", "",
+                    [Sample("", {"engine": label}, 1)],
+                )
+            ]
+        )
+    fams = [f for f in reg.collect() if f.name == "x_total"]
+    assert len(fams) == 1
+    assert len(fams[0].samples) == 2
+
+
+def test_varz_shape():
+    reg = MetricsRegistry()
+    reg.counter("a_total", "help a", ("l",)).inc(("v",))
+    v = reg.varz()
+    assert v["a_total"]["type"] == "counter"
+    assert v["a_total"]["values"][0] == {
+        "suffix": "", "labels": {"l": "v"}, "value": 1,
+    }
+
+
+def test_global_registry_is_singleton_and_threadsafe():
+    assert get_global_registry() is get_global_registry()
+    reg = MetricsRegistry()
+    c = reg.counter("hits_total")
+    threads = [
+        threading.Thread(
+            target=lambda: [c.inc() for _ in range(1000)]
+        )
+        for _ in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.get() == 8000

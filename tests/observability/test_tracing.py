@@ -1,0 +1,125 @@
+"""Span tracer: parent links, bounded ring, Chrome trace export."""
+
+import json
+import threading
+
+from keystone_tpu.observability.tracing import (
+    Tracer,
+    disable_tracing,
+    enable_tracing,
+    get_tracer,
+)
+
+
+def test_span_nesting_records_parent_links():
+    tr = Tracer()
+    with tr.span("outer") as outer:
+        with tr.span("inner") as inner:
+            assert inner.parent_id == outer.span_id
+        with tr.span("sibling") as sib:
+            assert sib.parent_id == outer.span_id
+    spans = {s.name: s for s in tr.recent()}
+    assert spans["inner"].parent_id == spans["outer"].span_id
+    assert spans["sibling"].parent_id == spans["outer"].span_id
+    assert spans["outer"].parent_id is None
+    # children finish before their parent
+    names = [s.name for s in tr.recent()]
+    assert names.index("inner") < names.index("outer")
+
+
+def test_span_attrs_and_set_attr():
+    tr = Tracer()
+    with tr.span("work", bucket=8) as sp:
+        sp.set_attr("rows", 5)
+    (done,) = tr.recent()
+    assert done.attrs == {"bucket": 8, "rows": 5}
+    assert done.duration_s >= 0
+
+
+def test_ring_is_bounded():
+    tr = Tracer(capacity=10)
+    for i in range(25):
+        with tr.span(f"s{i}"):
+            pass
+    spans = tr.recent()
+    assert len(spans) == 10
+    assert spans[-1].name == "s24"  # most recent kept
+    assert tr.recent(3)[0].name == "s22"
+
+
+def test_disabled_tracer_records_nothing():
+    tr = Tracer(enabled=False)
+    with tr.span("invisible") as sp:
+        sp.set_attr("k", "v")  # no-op, no crash
+    assert tr.recent() == []
+    assert tr.start_span("also_invisible").span_id is None
+
+
+def test_parent_links_are_thread_local():
+    tr = Tracer()
+    seen = {}
+
+    def worker(name):
+        with tr.span(name):
+            pass
+
+    with tr.span("main_outer"):
+        t = threading.Thread(target=worker, args=("other_thread",))
+        t.start()
+        t.join()
+    spans = {s.name: s for s in tr.recent()}
+    # the other thread's span must NOT parent under main's open span
+    assert spans["other_thread"].parent_id is None
+    assert spans["other_thread"].thread_id != spans["main_outer"].thread_id
+
+
+def test_chrome_trace_structure_loads_as_json(tmp_path):
+    tr = Tracer()
+    with tr.span("outer", engine="e0"):
+        with tr.span("inner"):
+            pass
+    doc = tr.to_chrome_trace()
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    events = doc["traceEvents"]
+    assert len(events) == 2
+    for e in events:
+        assert e["ph"] == "X"  # complete events
+        assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert "span_id" in e["args"] and "parent_id" in e["args"]
+    by_name = {e["name"]: e for e in events}
+    assert (
+        by_name["inner"]["args"]["parent_id"]
+        == by_name["outer"]["args"]["span_id"]
+    )
+    # inner nests temporally within outer
+    assert by_name["inner"]["ts"] >= by_name["outer"]["ts"]
+
+    path = tr.export_chrome_trace(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        reloaded = json.load(f)
+    assert reloaded["traceEvents"][0]["name"] in ("outer", "inner")
+
+
+def test_global_tracer_enable_disable():
+    tr = get_tracer()
+    assert tr is get_tracer()
+    try:
+        enable_tracing()
+        assert tr.enabled
+        with tr.span("global_span"):
+            pass
+        assert any(s.name == "global_span" for s in tr.recent())
+    finally:
+        disable_tracing()
+        tr.clear()
+    assert not tr.enabled
+
+
+def test_out_of_order_end_is_tolerated():
+    tr = Tracer()
+    a = tr.start_span("a")
+    b = tr.start_span("b")
+    tr.end_span(a)  # ended before its child
+    tr.end_span(b)
+    assert {s.name for s in tr.recent()} == {"a", "b"}

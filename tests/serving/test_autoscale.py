@@ -1,0 +1,125 @@
+"""Bucket autoscaler: propose a bucket set from observed traffic."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.serving.autoscale import padding_waste, suggest_buckets
+from keystone_tpu.serving.metrics import ServingMetrics
+
+
+def test_clustered_traffic_mix_finds_the_clusters():
+    """Synthetic mix with three obvious size clusters: the optimal
+    3-bucket set is each cluster's max."""
+    hist = {}
+    for s in (1, 2, 3, 4):
+        hist[s] = 100  # interactive singles
+    for s in (60, 62, 64):
+        hist[s] = 50  # mid batches
+    for s in (500, 510, 512):
+        hist[s] = 10  # bulk
+    buckets = suggest_buckets(hist, 3)
+    assert buckets == (4, 64, 512)
+
+
+def test_proposal_beats_default_buckets_on_waste():
+    rng = np.random.default_rng(0)
+    hist = {}
+    # bimodal: most traffic tiny, a bulk tail
+    for s in rng.integers(1, 9, 400):
+        hist[int(s)] = hist.get(int(s), 0) + 1
+    for s in rng.integers(120, 129, 100):
+        hist[int(s)] = hist.get(int(s), 0) + 1
+    proposed = suggest_buckets(hist, 3)
+    naive = (8, 64, 512)
+    assert padding_waste(hist, proposed) <= padding_waste(hist, naive)
+
+
+def test_k_larger_than_distinct_sizes_returns_sizes():
+    assert suggest_buckets({4: 10, 16: 1}, 5) == (4, 16)
+
+
+def test_single_bucket_is_the_max():
+    assert suggest_buckets({3: 5, 7: 1, 12: 2}, 1) == (12,)
+
+
+def test_largest_observed_size_is_always_covered():
+    rng = np.random.default_rng(1)
+    hist = {int(s): int(c) for s, c in zip(
+        rng.integers(1, 300, 40), rng.integers(1, 50, 40)
+    )}
+    for k in (1, 2, 4, 6):
+        buckets = suggest_buckets(hist, k)
+        assert buckets[-1] == max(hist)
+        assert len(buckets) <= k
+        assert list(buckets) == sorted(set(buckets))
+
+
+def test_weighting_matters():
+    """Same sizes, different counts -> different proposal: the heavy
+    size pulls a dedicated bucket."""
+    light = suggest_buckets({10: 1, 100: 1, 101: 1000}, 2)
+    heavy = suggest_buckets({10: 1000, 100: 1, 101: 1}, 2)
+    # exact-fit for the dominant size in both cases
+    assert 101 in light
+    assert 10 in heavy
+
+
+def test_max_bucket_clamps_oversized_requests():
+    buckets = suggest_buckets({4: 10, 1000: 5}, 2, max_bucket=256)
+    assert buckets[-1] == 256
+
+
+def test_max_bucket_models_chunk_tails_not_clamping():
+    """Oversized requests chunk through max_bucket at serving time; the
+    proposal must optimize for the TAIL (size % max_bucket), matching
+    padding_waste — clamping would report zero waste while serving pays
+    for every tail."""
+    hist = {10: 100}
+    buckets = suggest_buckets(hist, 2, max_bucket=4)
+    assert buckets == (2, 4)  # tail of 10 = 4+4+2 is exactly covered
+    assert padding_waste(hist, buckets) == 0
+    # evenly-chunking traffic: nothing below the forced bucket needed
+    assert suggest_buckets({8: 10, 16: 3}, 3, max_bucket=8) == (8,)
+
+
+def test_max_bucket_is_always_in_the_result():
+    assert 8 in suggest_buckets({3: 5}, 2, max_bucket=8)
+    assert suggest_buckets({3: 5}, 1, max_bucket=8) == (8,)
+
+
+def test_exactness_against_brute_force():
+    """DP proposal matches exhaustive search over all bucket subsets on
+    a small instance."""
+    import itertools
+
+    rng = np.random.default_rng(2)
+    sizes = sorted(rng.choice(np.arange(1, 40), size=7, replace=False))
+    hist = {int(s): int(c) for s, c in zip(sizes, rng.integers(1, 20, 7))}
+    for k in (2, 3):
+        best = min(
+            (
+                padding_waste(hist, combo + (max(hist),))
+                for combo in itertools.combinations(sorted(hist), k - 1)
+            ),
+            default=padding_waste(hist, (max(hist),)),
+        )
+        got = suggest_buckets(hist, k)
+        assert padding_waste(hist, got) == best
+
+
+def test_empty_histogram_raises():
+    with pytest.raises(ValueError):
+        suggest_buckets({}, 3)
+    with pytest.raises(ValueError):
+        suggest_buckets(ServingMetrics(), 3)
+    with pytest.raises(ValueError):
+        suggest_buckets({4: 10}, 0)
+
+
+def test_reads_live_serving_metrics():
+    m = ServingMetrics()
+    for _ in range(30):
+        m.record_dispatch(bucket=8, n_valid=3, seconds=0.001)
+    for _ in range(5):
+        m.record_dispatch(bucket=64, n_valid=50, seconds=0.002)
+    assert suggest_buckets(m, 2) == (3, 50)

@@ -1,0 +1,140 @@
+"""ServingMetrics: windowed rate, atomic latency snapshot, registry
+bridge lifecycle."""
+
+import gc
+import time
+
+import pytest
+
+from keystone_tpu.observability.registry import MetricsRegistry
+from keystone_tpu.serving.metrics import ServingMetrics
+from keystone_tpu.utils.profiling import LatencyRecorder
+
+
+def test_latency_recorder_p95_and_snapshot():
+    rec = LatencyRecorder()
+    for v in range(1, 101):  # 1..100 ms
+        rec.record(v / 1000.0)
+    assert rec.p95 is not None
+    snap = rec.snapshot()
+    assert snap["count"] == 100
+    assert abs(snap["total"] - 5.05) < 1e-9
+    assert abs(snap["p50"] - 0.0505) < 1e-3
+    assert abs(snap["p95"] - 0.09505) < 1e-3
+    assert abs(snap["p99"] - 0.09901) < 1e-3
+    # empty recorder: percentiles None, zeros for count/total
+    empty = LatencyRecorder().snapshot()
+    assert empty == {
+        "count": 0, "total": 0.0, "p50": None, "p95": None, "p99": None,
+    }
+
+
+def test_windowed_rate_decays_to_zero_but_lifetime_does_not_jump():
+    m = ServingMetrics()
+    m.record_dispatch(bucket=8, n_valid=8, seconds=0.001)
+    # fresh traffic: windowed rate sees all 8 examples over a tiny
+    # lifetime (clamped window), so it's large and positive
+    assert m.examples_per_sec() > 0
+    # a very small window that has already passed: rate decays to zero
+    time.sleep(0.05)
+    assert m.examples_per_sec(window=0.01) == 0.0
+    # the lifetime average still counts them (the documented wart the
+    # windowed gauge exists to fix: lifetime dilutes over idle time,
+    # windowed goes to zero)
+    assert m.examples_per_sec_lifetime() > 0
+
+
+def test_summary_uses_windowed_rate_and_snapshot_quantiles():
+    m = ServingMetrics()
+    for _ in range(4):
+        m.record_dispatch(bucket=8, n_valid=8, seconds=0.002)
+    s = m.summary()
+    assert "examples_per_sec" in s
+    assert "examples_per_sec_lifetime" in s
+    assert s["examples_per_sec"] > 0
+    assert s["dispatch_p95_ms"] is not None
+    assert s["dispatch_p50_ms"] <= s["dispatch_p99_ms"]
+    assert s["request_p95_ms"] is None  # no micro-batched requests yet
+
+
+def test_request_size_histogram_accumulates():
+    m = ServingMetrics()
+    m.record_dispatch(bucket=8, n_valid=3, seconds=0.001)
+    m.record_dispatch(bucket=8, n_valid=3, seconds=0.001)
+    m.record_dispatch(bucket=64, n_valid=40, seconds=0.001)
+    assert m.request_sizes.snapshot() == {3: 2, 40: 1}
+
+
+def test_register_exports_and_prunes_after_gc():
+    reg = MetricsRegistry()
+    m = ServingMetrics()
+    m.record_dispatch(bucket=8, n_valid=5, seconds=0.001)
+    label = m.register(registry=reg, engine="e-test")
+    assert label == "e-test"
+    fams = {f.name for f in reg.collect()}
+    assert "keystone_serving_compiles_total" in fams
+    assert "keystone_serving_dispatch_latency_seconds" in fams
+    del m
+    gc.collect()
+    assert not any("keystone_serving" in f.name for f in reg.collect())
+
+
+def test_global_register_is_idempotent():
+    m = ServingMetrics()
+    first = m.register()
+    assert m.register() == first  # no double export
+
+
+def test_windowed_rate_clamps_oversized_window():
+    """Events older than RATE_WINDOW_S are pruned at record time, so a
+    window larger than that must clamp instead of silently dividing a
+    30s sum by more seconds (4x undercount otherwise)."""
+    m = ServingMetrics()
+    m.record_dispatch(bucket=8, n_valid=8, seconds=0.001)
+    lifetime = m.examples_per_sec()  # window = lifetime here (young)
+    assert m.examples_per_sec(window=1e6) == pytest.approx(
+        lifetime, rel=0.5
+    )
+    assert m.examples_per_sec(window=1e6) > 0
+
+
+def test_same_label_reregistration_transfers_ownership():
+    """The engine-swap loop re-registers a NEW metrics under the SAME
+    label while the old engine is still alive: the newest owner wins
+    and exactly one series set per label survives (duplicate series
+    would fail a whole Prometheus scrape)."""
+    reg = MetricsRegistry()
+    old = ServingMetrics()
+    old.record_dispatch(bucket=8, n_valid=1, seconds=0.001)
+    new = ServingMetrics()
+    for _ in range(3):
+        new.record_dispatch(bucket=8, n_valid=2, seconds=0.001)
+    old.register(registry=reg, engine="prod")
+    new.register(registry=reg, engine="prod")
+    samples = [
+        s
+        for f in reg.collect()
+        if f.name == "keystone_serving_examples_total"
+        for s in f.samples
+        if s.labels.get("engine") == "prod"
+    ]
+    assert len(samples) == 1  # no duplicate series
+    assert samples[0].value == 6  # the NEW engine's counter
+    # the superseded collector pruned itself; old engine still alive
+    assert old.examples.total == 1
+
+
+def test_engine_autoregisters_into_global_registry():
+    from keystone_tpu.observability.registry import get_global_registry
+    from keystone_tpu.serving.bench import build_pipeline
+
+    fitted = build_pipeline(d=4, hidden=4, depth=1)
+    engine = fitted.compiled(buckets=(2,), name="autoreg-test")
+    assert engine.name == "autoreg-test"
+    samples = [
+        s
+        for f in get_global_registry().collect()
+        if f.name == "keystone_serving_examples_total"
+        for s in f.samples
+    ]
+    assert any(s.labels.get("engine") == "autoreg-test" for s in samples)
