@@ -1508,11 +1508,14 @@ def bench_imagenet_real(data_dir: str, labels_path: str,
 
 
 def bench_serving() -> None:
-    """Serving fast path (serving/engine.py + batching.py): cold-vs-warm
-    dispatch latency on one shape, bucketed throughput across every
-    batch size with a compile-count ceiling, and micro-batched p99 —
-    vs_baseline null (the reference published no serving numbers; the
-    wiring exists so future rounds ratio against these rows)."""
+    """Serving fast path (serving/engine.py + batching.py) and request
+    plane (gateway/): cold-vs-warm dispatch latency on one shape,
+    bucketed throughput across every batch size with a compile-count
+    ceiling, micro-batched p99, gateway-plane p99 under the same load
+    (`serving_gateway_p99`), and the forced live-engine-swap blip with
+    zero failures asserted (`serving_swap_blip`) — vs_baseline null
+    (the reference published no serving numbers; the wiring exists so
+    future rounds ratio against these rows)."""
     from keystone_tpu.serving.bench import run_serving_benches
 
     run_serving_benches(emit)

@@ -44,6 +44,27 @@ def main(argv=None) -> int:
         server = start_admin_server(port=port)
         print(f"admin endpoint: {server.url()} "
               "(/metrics /varz /healthz /tracez)", flush=True)
+    gateway_port = None
+    if "--gateway-port" in argv:
+        # request plane: admission control + replica lanes + live
+        # engine swap in front of a compiled pipeline, HTTP /predict
+        # frontend (keystone_tpu/gateway/). Peeled here so
+        # `python -m keystone_tpu --gateway-port N` alone stands up the
+        # serve-gateway demo (bench pipeline); with an explicit
+        # serve-gateway app the port just rides along.
+        i = argv.index("--gateway-port")
+        try:
+            gateway_port = int(argv[i + 1])
+        except (IndexError, ValueError):
+            print("--gateway-port requires an integer port (0 = ephemeral)")
+            return 2
+        del argv[i : i + 2]
+        if not argv or argv[0].startswith("-"):
+            # no app named: everything left is serve-gateway options
+            argv = ["serve-gateway"] + argv
+        if argv[0] != "serve-gateway":
+            print("--gateway-port only applies to the serve-gateway app")
+            return 2
     if "--debug-optimizer" in argv:
         # Per-rule optimizer trace: node-count deltas at INFO, full DOT
         # graphs after each effective rule at DEBUG (reference logs DOT on
@@ -59,14 +80,23 @@ def main(argv=None) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print(
             "usage: python -m keystone_tpu [--debug-optimizer] "
-            "[--admin-port N] <AppName> [app args...]"
+            "[--admin-port N] [--gateway-port N] <AppName> [app args...]"
         )
         print("apps:")
         for name in sorted(APPS):
             print(f"  {name}")
         print("  serve-bench  (serving engine benchmarks; see "
               "keystone_tpu/serving/bench.py)")
+        print("  serve-gateway  (HTTP request plane over the bench "
+              "pipeline; keystone_tpu/gateway/)")
         print("options:")
+        print("  --gateway-port N shorthand for `serve-gateway "
+              "--gateway-port N`: admission-")
+        print("                   controlled HTTP inference frontend "
+              "(POST /predict, GET /readyz,")
+        print("                   POST /swap) with N replica lanes and "
+              "live re-bucketing. N=0")
+        print("                   picks an ephemeral port.")
         print("  --admin-port N   serve metrics on http://127.0.0.1:N —"
               " /metrics (Prometheus")
         print("                   text exposition of every live engine's"
@@ -83,6 +113,13 @@ def main(argv=None) -> int:
         from keystone_tpu.serving.bench import main as serve_bench_main
 
         return serve_bench_main(argv[1:])
+    if app == "serve-gateway":
+        from keystone_tpu.gateway.http import main as serve_gateway_main
+
+        rest = argv[1:]
+        if gateway_port is not None:
+            rest = ["--gateway-port", str(gateway_port)] + rest
+        return serve_gateway_main(rest)
     if app not in APPS:
         print(f"unknown app {app!r}; run with --help for the list")
         return 2

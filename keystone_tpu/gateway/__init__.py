@@ -1,0 +1,45 @@
+"""Request gateway: the serving plane's front door.
+
+PR 1 built the per-engine fast path (``CompiledPipeline`` +
+``MicroBatcher``) and PR 2 made it observable; this package owns engine
+*lifecycle* and the request plane in front of it:
+
+- ``AdmissionController`` (admission.py): bounded queue, per-request
+  deadline propagation, and load shedding with a typed ``Overloaded``
+  error — beyond-capacity traffic is rejected immediately instead of
+  collapsing latency for everyone.
+- ``EnginePool`` (pool.py): N shared-nothing replica lanes (one
+  micro-batcher + engine pair each), least-loaded routing, per-lane
+  health with half-open recovery, and retry-to-another-lane on lane
+  failure.
+- ``Gateway`` (lifecycle.py): build + warm lanes, the live autoscale
+  loop (observed size histogram -> ``suggest_buckets`` -> warm
+  replacement -> atomic swap -> drain), graceful shutdown on
+  ``close()``/SIGTERM.
+- ``GatewayServer`` (http.py): stdlib HTTP frontend — ``POST
+  /predict``, ``GET /readyz`` (readiness, distinct from the admin
+  plane's ``/healthz`` liveness), ``GET /metrics``, ``POST /swap``,
+  ``POST /drain``.
+
+Everything publishes through the PR 2 observability plane:
+``keystone_gateway_shed_total``, ``keystone_gateway_retries_total``,
+``keystone_gateway_engine_swaps_total``, native-histogram queue-wait
+and request-latency series, and ``gateway.admit`` spans parenting the
+``microbatch.coalesce`` -> ``serving.dispatch`` chain.
+"""
+
+from keystone_tpu.gateway.admission import AdmissionController, Overloaded
+from keystone_tpu.gateway.http import GatewayServer
+from keystone_tpu.gateway.lifecycle import Gateway
+from keystone_tpu.gateway.metrics import GatewayMetrics
+from keystone_tpu.gateway.pool import EnginePool, Lane
+
+__all__ = [
+    "AdmissionController",
+    "EnginePool",
+    "Gateway",
+    "GatewayMetrics",
+    "GatewayServer",
+    "Lane",
+    "Overloaded",
+]

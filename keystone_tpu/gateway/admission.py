@@ -1,0 +1,298 @@
+"""Admission control: the gateway's front door.
+
+Every request passes one policy gate BEFORE it can touch an engine:
+
+- **bounded queue** — at most ``max_pending`` admitted-but-unrouted
+  requests; the router hands them to pool lanes only as lane capacity
+  frees, so backpressure is explicit instead of an unbounded pile-up
+  inside the micro-batchers;
+- **load shedding** — a request is rejected IMMEDIATELY with a typed
+  ``Overloaded`` error when the queue is full or when the estimated
+  wait (pending work over the measured completion rate) already exceeds
+  the request's deadline. Shedding the request that cannot make its
+  deadline anyway keeps latency flat for the requests that can — the
+  alternative is every request's latency collapsing together;
+- **deadline propagation** — the deadline travels with the request: if
+  it expires while queued (load arrived after admission), the router
+  sheds it at hand-off time instead of wasting engine cycles on an
+  answer nobody is waiting for.
+
+Instrumented via ``GatewayMetrics``: ``keystone_gateway_shed_total``
+by reason, queue-depth/inflight gauges, and the queue-wait native
+histogram. Each admission opens a ``gateway.admit`` span whose id rides
+with the request so the micro-batcher's ``microbatch.coalesce`` span —
+on another thread — parents under it, completing the
+admit → coalesce → dispatch chain in ``/tracez``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Deque, Optional
+
+from keystone_tpu.gateway.metrics import GatewayMetrics
+from keystone_tpu.observability.tracing import get_tracer
+
+logger = logging.getLogger(__name__)
+
+# completion-rate estimator: window and the minimum evidence before the
+# estimated-wait shed rule activates (a cold gateway never deadline-sheds)
+RATE_WINDOW_S = 10.0
+MIN_RATE_SAMPLES = 8
+
+
+class Overloaded(RuntimeError):
+    """Typed shed/reject error. ``reason`` is one of:
+
+    - ``queue_full`` — the bounded admission queue is at capacity;
+    - ``deadline``   — estimated wait exceeds the request's deadline;
+    - ``expired``    — the deadline passed while the request was queued;
+    - ``closed``     — the gateway is draining and admits nothing.
+
+    HTTP maps these to 429 (shed), 504 (expired), 503 (closed)."""
+
+    def __init__(
+        self,
+        reason: str,
+        queue_depth: Optional[int] = None,
+        est_wait_s: Optional[float] = None,
+        deadline_s: Optional[float] = None,
+    ):
+        self.reason = reason
+        self.queue_depth = queue_depth
+        self.est_wait_s = est_wait_s
+        self.deadline_s = deadline_s
+        parts = [f"overloaded ({reason})"]
+        if queue_depth is not None:
+            parts.append(f"queue_depth={queue_depth}")
+        if est_wait_s is not None:
+            parts.append(f"est_wait={est_wait_s * 1e3:.1f}ms")
+        if deadline_s is not None:
+            parts.append(f"deadline={deadline_s * 1e3:.1f}ms")
+        super().__init__(" ".join(parts))
+
+
+def _fail(fut: Future, err: BaseException) -> None:
+    """Resolve ``fut`` with ``err``, tolerating a caller cancelling in
+    the same instant (InvalidStateError) — the caller stopped waiting,
+    nobody needs the error."""
+    try:
+        fut.set_exception(err)
+    except Exception:
+        pass
+
+
+@dataclasses.dataclass
+class _Request:
+    example: Any
+    future: Future
+    t_admit: float
+    deadline_t: Optional[float]  # absolute perf_counter deadline
+    parent_span_id: Optional[int]
+
+
+class AdmissionController:
+    """Bounded-queue admission in front of an ``EnginePool`` (anything
+    with ``submit``/``free_capacity``/``total_load``/
+    ``add_free_listener`` — tests stub it)."""
+
+    def __init__(
+        self,
+        pool,
+        max_pending: int = 1024,
+        default_deadline_ms: Optional[float] = None,
+        metrics: Optional[GatewayMetrics] = None,
+        name: str = "gateway",
+    ):
+        if max_pending < 1:
+            raise ValueError(f"max_pending must be >= 1, got {max_pending}")
+        self.pool = pool
+        self.name = name
+        self.max_pending = max_pending
+        self.default_deadline_ms = default_deadline_ms
+        self.metrics = metrics if metrics is not None else GatewayMetrics(
+            gateway=name
+        )
+        self._queue: Deque[_Request] = collections.deque()
+        self._cond = threading.Condition()
+        self._accepting = True
+        self._completions: Deque[float] = collections.deque(maxlen=2048)
+        self._comp_lock = threading.Lock()
+        pool.add_free_listener(self._wake)
+        self._router = threading.Thread(
+            target=self._route_loop, name=f"keystone-{name}-router",
+            daemon=True,
+        )
+        self._router.start()
+        self.metrics.set_ready(True)
+
+    # -- client side -------------------------------------------------------
+
+    @property
+    def accepting(self) -> bool:
+        return self._accepting
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def estimated_wait_s(self) -> Optional[float]:
+        """Pending work (queued + in-lane) over the measured completion
+        rate; ``None`` until enough completions exist to estimate."""
+        now = time.perf_counter()
+        with self._comp_lock:
+            while (
+                self._completions
+                and self._completions[0] < now - RATE_WINDOW_S
+            ):
+                self._completions.popleft()
+            n = len(self._completions)
+            if n < MIN_RATE_SAMPLES:
+                return None
+            span = now - self._completions[0]
+        rate = n / max(span, 1e-3)
+        pending = len(self._queue) + self.pool.total_load()
+        return pending / rate
+
+    def submit(
+        self, example: Any, deadline_ms: Optional[float] = None
+    ) -> Future:
+        """Admit one example or raise ``Overloaded``. The returned
+        future resolves with the example's pipeline output (or the
+        terminal error after any lane retry)."""
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        deadline_s = deadline_ms / 1e3 if deadline_ms is not None else None
+        with get_tracer().span(
+            "gateway.admit", gateway=self.name
+        ) as span:
+            with self._cond:
+                if not self._accepting:
+                    self.metrics.record_shed("closed")
+                    raise Overloaded("closed")
+                depth = len(self._queue)
+                if depth >= self.max_pending:
+                    self.metrics.record_shed("queue_full")
+                    raise Overloaded("queue_full", queue_depth=depth)
+                if deadline_s is not None:
+                    est = self.estimated_wait_s()
+                    if est is not None and est > deadline_s:
+                        self.metrics.record_shed("deadline")
+                        raise Overloaded(
+                            "deadline",
+                            queue_depth=depth,
+                            est_wait_s=est,
+                            deadline_s=deadline_s,
+                        )
+                t = time.perf_counter()
+                req = _Request(
+                    example=example,
+                    future=Future(),
+                    t_admit=t,
+                    deadline_t=(
+                        t + deadline_s if deadline_s is not None else None
+                    ),
+                    parent_span_id=span.span_id,
+                )
+                self._queue.append(req)
+                self.metrics.set_queue_depth(len(self._queue))
+                self._cond.notify()
+        return req.future
+
+    # -- router ------------------------------------------------------------
+
+    def _wake(self) -> None:
+        with self._cond:
+            self._cond.notify()
+
+    def _route_loop(self) -> None:
+        while True:
+            with self._cond:
+                while (
+                    self._accepting
+                    and not (self._queue and self.pool.free_capacity() > 0)
+                ):
+                    # the timeout backstops missed capacity signals
+                    # (e.g. a lane flipping healthy on its cool-down)
+                    self._cond.wait(0.05)
+                if not self._accepting and not self._queue:
+                    return  # drained and draining: router done
+                if not self._queue:
+                    continue
+                req = self._queue.popleft()
+                self.metrics.set_queue_depth(len(self._queue))
+            if req.future.cancelled():
+                # caller gave up while queued (e.g. the HTTP frontend
+                # shedding a partially-admitted /predict): spend nothing
+                continue
+            now = time.perf_counter()
+            if req.deadline_t is not None and now > req.deadline_t:
+                # the deadline died in the queue: shed at hand-off,
+                # don't spend engine time on it
+                self.metrics.record_shed("expired")
+                _fail(
+                    req.future,
+                    Overloaded(
+                        "expired",
+                        deadline_s=req.deadline_t - req.t_admit,
+                    ),
+                )
+                continue
+            self.metrics.record_queue_wait(now - req.t_admit)
+            try:
+                lane_fut = self.pool.submit(
+                    req.example, parent_span_id=req.parent_span_id
+                )
+            except Exception as e:
+                _fail(req.future, e)
+                continue
+            self.metrics.set_inflight(self.pool.total_load())
+            lane_fut.add_done_callback(
+                lambda f, req=req: self._finish(req, f)
+            )
+
+    def _finish(self, req: _Request, lane_fut: Future) -> None:
+        now = time.perf_counter()
+        with self._comp_lock:
+            self._completions.append(now)
+        self.metrics.set_inflight(self.pool.total_load())
+        self.metrics.record_latency(now - req.t_admit)
+        err = lane_fut.exception()
+        if err is None:
+            self.metrics.record_outcome("ok")
+            if not req.future.cancelled():
+                req.future.set_result(lane_fut.result())
+        else:
+            self.metrics.record_outcome("error")
+            _fail(req.future, err)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self, timeout: Optional[float] = 10.0) -> None:
+        """Stop admitting (new submits raise ``Overloaded('closed')``),
+        let the router drain what was already admitted, then return.
+        The pool keeps serving the drained requests; closing it is the
+        gateway's job after this returns."""
+        with self._cond:
+            if not self._accepting:
+                return
+            self._accepting = False
+            self.metrics.set_ready(False)
+            self._cond.notify_all()
+        self._router.join(timeout)
+        if self._router.is_alive():
+            logger.warning(
+                "admission router still draining after %.1fs", timeout
+            )
+
+    def __enter__(self) -> "AdmissionController":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

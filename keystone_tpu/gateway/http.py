@@ -1,0 +1,280 @@
+"""HTTP inference frontend: the network face of the gateway.
+
+A stdlib ``http.server`` on a background daemon thread, following the
+``observability/admin.py`` server pattern (nothing to install, ephemeral
+``port=0`` for tests/smoke, daemon threads per request). Routes:
+
+- ``POST /predict`` — body ``{"instances": [<example>, ...]}`` (each
+  instance one example WITHOUT the batch axis; numbers nest as JSON
+  arrays), optional ``"deadline_ms"``. Every instance is admitted
+  individually, so concurrent clients coalesce in the micro-batchers.
+  Responds ``{"predictions": [...]}``; typed errors map to status
+  codes: 429 shed (``Overloaded``: queue_full/deadline), 504 expired,
+  503 draining/closed, 400 malformed, 500 engine error.
+- ``GET /readyz`` — 200 while the gateway admits, 503 once draining.
+  READINESS, not liveness: the admin endpoint's ``/healthz`` answers
+  "is the process up", this answers "should the load balancer route
+  here" — a draining gateway is alive but not ready. A convenience
+  ``GET /healthz`` is also served for single-port deployments.
+- ``GET /metrics`` — Prometheus exposition of the (global) registry,
+  so a gateway-only deployment is scrapeable without the admin server.
+- ``POST /swap`` — force one lifecycle iteration
+  (``Gateway.rebucket(force=True)``); returns the active bucket set.
+  The smoke script's forced-swap drill.
+- ``POST /drain`` — begin graceful shutdown in the background;
+  ``/readyz`` flips 503 immediately, admitted requests resolve.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Any
+from urllib.parse import urlparse
+
+import numpy as np
+
+from keystone_tpu.gateway.admission import Overloaded
+from keystone_tpu.gateway.lifecycle import Gateway
+from keystone_tpu.observability import prometheus
+from keystone_tpu.observability.httpd import BackgroundServer, JsonHandler
+from keystone_tpu.observability.registry import get_global_registry
+
+logger = logging.getLogger(__name__)
+
+# generous server-side ceiling for waiting on one prediction; requests
+# with their own deadline wait deadline + slack instead
+RESULT_TIMEOUT_S = 60.0
+
+
+def _status_for(err: Overloaded) -> int:
+    if err.reason == "closed":
+        return 503
+    if err.reason == "expired":
+        return 504
+    return 429
+
+
+class _Handler(JsonHandler):
+    def _send_error_json(self, code: int, error: str, **extra) -> None:
+        self._send_json({"error": error, **extra}, code=code)
+
+    @property
+    def gateway(self) -> Gateway:
+        return self.server.gateway  # type: ignore[attr-defined]
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib handler API)
+        path = urlparse(self.path).path
+        try:
+            if path == "/readyz":
+                if self.gateway.ready:
+                    self._send_text(200, "ok\n")
+                else:
+                    self._send_text(503, "draining\n")
+            elif path == "/healthz":
+                self._send_text(200, "ok\n")
+            elif path == "/metrics":
+                registry = self.server.registry  # type: ignore[attr-defined]
+                body = prometheus.render(registry.collect())
+                self._send(
+                    200, body.encode("utf-8"), prometheus.CONTENT_TYPE
+                )
+            else:
+                self._send_text(
+                    404,
+                    "not found; try /predict /readyz /healthz /metrics\n",
+                )
+        except Exception as e:
+            logger.exception("gateway GET error for %s", self.path)
+            self._send_error_json(500, "internal", detail=str(e))
+
+    def do_POST(self) -> None:  # noqa: N802 (stdlib handler API)
+        path = urlparse(self.path).path
+        try:
+            if path == "/predict":
+                self._predict()
+            elif path == "/swap":
+                swapped = self.gateway.rebucket(force=True)
+                self._send_json(
+                    {
+                        "swapped": swapped,
+                        "buckets": list(self.gateway.buckets),
+                    }
+                )
+            elif path == "/drain":
+                threading.Thread(
+                    target=self.gateway.close,
+                    name="keystone-gateway-drain",
+                    daemon=True,
+                ).start()
+                self._send_json({"draining": True})
+            else:
+                self._send_text(404, "not found; try /predict /swap /drain\n")
+        except Overloaded as e:
+            self._send_error_json(
+                _status_for(e), "overloaded", reason=e.reason,
+                detail=str(e),
+            )
+        except Exception as e:
+            logger.exception("gateway POST error for %s", self.path)
+            self._send_error_json(500, "internal", detail=str(e))
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        return self.rfile.read(length) if length else b""
+
+    def _predict(self) -> None:
+        try:
+            doc = json.loads(self._read_body() or b"{}")
+            instances = doc["instances"]
+            if not isinstance(instances, list) or not instances:
+                raise ValueError("instances must be a non-empty list")
+        except (ValueError, KeyError, TypeError) as e:
+            self._send_error_json(400, "bad_request", detail=str(e))
+            return
+        deadline_ms = doc.get("deadline_ms")
+        if deadline_ms is not None and (
+            isinstance(deadline_ms, bool)
+            or not isinstance(deadline_ms, (int, float))
+            or deadline_ms <= 0
+        ):
+            self._send_error_json(
+                400, "bad_request",
+                detail=f"deadline_ms must be a positive number, "
+                       f"got {deadline_ms!r}",
+            )
+            return
+        dtype = self.server.input_dtype  # type: ignore[attr-defined]
+        try:
+            examples = [np.asarray(inst, dtype=dtype) for inst in instances]
+        except (ValueError, TypeError) as e:
+            self._send_error_json(400, "bad_request", detail=str(e))
+            return
+        # admit every instance BEFORE waiting on any: concurrent
+        # instances coalesce into shared micro-batch windows
+        futures = []
+        try:
+            for ex in examples:
+                futures.append(
+                    self.gateway.predict(ex, deadline_ms=deadline_ms)
+                )
+        except Overloaded:
+            # partial admission on a shed response: cancel what was
+            # already admitted so the engines don't burn overload-time
+            # cycles computing results this 429 discards
+            for f in futures:
+                f.cancel()
+            raise  # -> do_POST's typed handler
+        timeout = (
+            deadline_ms / 1e3 + 5.0
+            if deadline_ms is not None
+            else RESULT_TIMEOUT_S
+        )
+        try:
+            preds = [np.asarray(f.result(timeout=timeout)) for f in futures]
+        except Overloaded:
+            # one instance shed/expired -> whole response is an error:
+            # cancel the siblings so engines don't compute answers this
+            # response discards (same reason as the admission path above)
+            for f in futures:
+                f.cancel()
+            raise
+        except Exception as e:
+            for f in futures:
+                f.cancel()
+            self._send_error_json(500, "prediction_failed", detail=str(e))
+            return
+        self._send_json({"predictions": [p.tolist() for p in preds]})
+
+
+class GatewayServer(BackgroundServer):
+    """The inference frontend over one ``Gateway``. ``start()`` binds
+    and serves on a daemon thread; ``stop()`` shuts the listener down
+    (the gateway itself drains via ``Gateway.close``/``/drain``)."""
+
+    handler_cls = _Handler
+    thread_name = "keystone-gateway-http"
+
+    def __init__(
+        self,
+        gateway: Gateway,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        registry=None,
+        input_dtype: Any = np.float32,
+    ):
+        super().__init__(port=port, host=host)
+        self.gateway = gateway
+        self.registry = (
+            registry if registry is not None else get_global_registry()
+        )
+        self.input_dtype = np.dtype(input_dtype)
+
+    def _configure(self, httpd) -> None:
+        httpd.gateway = self.gateway
+        httpd.registry = self.registry
+        httpd.input_dtype = self.input_dtype
+
+
+def main(argv=None) -> int:
+    """``python -m keystone_tpu serve-gateway [--gateway-port N] ...`` —
+    stand up the full request plane over the serve-bench pipeline (the
+    demo/smoke entry; real deployments construct ``Gateway`` over their
+    own fitted pipeline)."""
+    import argparse
+    import time
+
+    import jax.numpy as jnp
+
+    from keystone_tpu.parallel.runtime import setup_compilation_cache
+    from keystone_tpu.serving.bench import build_pipeline
+
+    ap = argparse.ArgumentParser(
+        prog="keystone_tpu serve-gateway", description=__doc__
+    )
+    ap.add_argument("--gateway-port", "--port", dest="port", type=int,
+                    default=0, help="bind port (0 = ephemeral)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--buckets", default="8,32,128")
+    ap.add_argument("--lanes", type=int, default=2)
+    ap.add_argument("--max-pending", type=int, default=1024)
+    ap.add_argument("--max-delay-ms", type=float, default=5.0)
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="default per-request deadline")
+    ap.add_argument("--rebucket-interval", type=float, default=None,
+                    help="seconds between autoscale/rebucket sweeps")
+    ap.add_argument("--d", type=int, default=256)
+    ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--depth", type=int, default=4)
+    ap.add_argument("--no-cache", action="store_true")
+    args = ap.parse_args(argv)
+    if not args.no_cache:
+        setup_compilation_cache()
+
+    fitted = build_pipeline(d=args.d, hidden=args.hidden, depth=args.depth)
+    gateway = Gateway(
+        fitted,
+        buckets=tuple(int(b) for b in args.buckets.split(",")),
+        n_lanes=args.lanes,
+        max_delay_ms=args.max_delay_ms,
+        warmup_example=jnp.zeros((args.d,), jnp.float32),
+        max_pending=args.max_pending,
+        default_deadline_ms=args.deadline_ms,
+        maintenance_interval_s=args.rebucket_interval,
+    )
+    gateway.install_signal_handlers()
+    server = GatewayServer(gateway, port=args.port, host=args.host).start()
+    print(
+        f"gateway: {server.url()} (POST /predict, GET /readyz, "
+        "GET /metrics, POST /swap, POST /drain)",
+        flush=True,
+    )
+    try:
+        while gateway.ready:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    gateway.close()
+    server.stop()
+    return 0

@@ -1,0 +1,268 @@
+"""Gateway: engine lifecycle, not just engine execution.
+
+``Gateway`` composes the request plane — ``AdmissionController`` in
+front of an ``EnginePool`` — and owns everything about the engines'
+*lives*:
+
+- **build + warm** — lanes come up with every bucket compiled before
+  the gateway reports ready (``warmup_example``), so cold compiles
+  never land in the traffic latency distribution;
+- **live re-bucketing** — ``rebucket()`` closes the PR 2 autoscale
+  loop: read the lanes' observed request-size histogram
+  (``ServingMetrics.request_sizes``), ask
+  ``serving/autoscale.suggest_buckets`` for the padding-minimal bucket
+  set, and when the proposal differs, build + warm replacement engines
+  in the background and atomically swap them behind the micro-batchers
+  (``EnginePool.swap``) — zero dropped requests, responses straddling
+  the swap numerically identical. A ``maintenance_interval_s`` runs
+  this periodically off a daemon thread;
+- **graceful shutdown** — ``close()`` (or SIGTERM via
+  ``install_signal_handlers``) flips readiness (``/readyz`` goes 503 so
+  load balancers stop sending), stops admitting (typed
+  ``Overloaded('closed')``), drains the admission queue, and flushes
+  every lane's micro-batcher so already-admitted requests resolve.
+
+Readiness vs liveness: ``ready`` is a routing signal (admitting and
+warmed) — the admin endpoint's ``/healthz`` stays the liveness probe
+(process up), and a draining gateway is alive but not ready.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, Optional, Sequence
+
+from keystone_tpu.gateway.admission import AdmissionController, Overloaded
+from keystone_tpu.gateway.metrics import GatewayMetrics
+from keystone_tpu.gateway.pool import EnginePool
+from keystone_tpu.serving.autoscale import suggest_buckets
+from keystone_tpu.serving.engine import DEFAULT_BUCKETS
+
+logger = logging.getLogger(__name__)
+
+# observations required before an UNFORCED rebucket may act: a proposal
+# from a handful of requests is noise, not traffic
+MIN_REBUCKET_OBSERVATIONS = 64
+
+
+class Gateway:
+    """The serving front door over one fitted pipeline.
+
+    Parameters
+    ----------
+    fitted:            the ``FittedPipeline`` to serve (each lane gets
+                       its own ``CompiledPipeline`` over it).
+    buckets:           initial row buckets per lane engine.
+    n_lanes:           replica lanes (shared-nothing engine copies).
+    warmup_example:    one example (no batch axis) used to pre-compile
+                       every bucket at construction and after each
+                       swap; without it lanes compile lazily and the
+                       first requests eat the compiles.
+    max_pending:       admission queue bound.
+    default_deadline_ms: deadline applied to requests that don't carry
+                       their own.
+    maintenance_interval_s: period of the background rebucket loop
+                       (None/0 = off; ``rebucket()`` stays callable).
+    rebucket_k:        bucket-set size the autoscaler proposes
+                       (default: len(buckets)).
+    """
+
+    def __init__(
+        self,
+        fitted,
+        *,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        n_lanes: int = 2,
+        max_delay_ms: float = 5.0,
+        lane_capacity: Optional[int] = None,
+        warmup_example: Any = None,
+        max_pending: int = 1024,
+        default_deadline_ms: Optional[float] = None,
+        maintenance_interval_s: Optional[float] = None,
+        rebucket_k: Optional[int] = None,
+        name: str = "gateway",
+        registry=None,
+    ):
+        self.name = name
+        self.fitted = fitted
+        # normalized exactly like CompiledPipeline normalizes its own
+        # bucket set, so buckets[-1] is genuinely the max bucket the
+        # rebucket loop must force and proposal comparisons are stable
+        self._buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self._warmup_example = warmup_example
+        self._rebucket_k = rebucket_k or len(self._buckets)
+        self.metrics = GatewayMetrics(registry=registry, gateway=name)
+        self.pool = EnginePool(
+            self._factory_for(self._buckets),
+            n_lanes,
+            name=name,
+            max_delay_ms=max_delay_ms,
+            lane_capacity=lane_capacity,
+            metrics=self.metrics,
+        )
+        if warmup_example is not None:
+            self.pool.warmup(warmup_example)
+        self.admission = AdmissionController(
+            self.pool,
+            max_pending=max_pending,
+            default_deadline_ms=default_deadline_ms,
+            metrics=self.metrics,
+            name=name,
+        )
+        self._closed = False
+        self._close_lock = threading.Lock()
+        self._drained = threading.Event()
+        # one swap at a time: the maintenance loop and POST /swap must
+        # not interleave build/swap/assign sequences
+        self._swap_lock = threading.RLock()
+        self._maint_stop = threading.Event()
+        self._maint: Optional[threading.Thread] = None
+        if maintenance_interval_s:
+            self._maint = threading.Thread(
+                target=self._maintenance_loop,
+                args=(float(maintenance_interval_s),),
+                name=f"keystone-{name}-lifecycle",
+                daemon=True,
+            )
+            self._maint.start()
+
+    def _factory_for(self, buckets):
+        def factory(lane_name: str):
+            return self.fitted.compiled(buckets=buckets, name=lane_name)
+
+        return factory
+
+    # -- serving -----------------------------------------------------------
+
+    def predict(
+        self, example: Any, deadline_ms: Optional[float] = None
+    ) -> Future:
+        """Admit one example; resolves to its pipeline output. Raises
+        ``Overloaded`` immediately when shed."""
+        return self.admission.submit(example, deadline_ms=deadline_ms)
+
+    @property
+    def ready(self) -> bool:
+        """Routing signal: admitting traffic (drain flips this false
+        before any request is refused)."""
+        return not self._closed and self.admission.accepting
+
+    @property
+    def buckets(self) -> tuple:
+        return self._buckets
+
+    # -- the live autoscale loop -------------------------------------------
+
+    def observed_sizes(self) -> Dict[int, int]:
+        """The pool-wide request-size histogram (every lane's engine
+        merged) — exactly what ``/metrics`` exports per lane as
+        ``keystone_serving_request_size_total``."""
+        merged: Dict[int, int] = {}
+        for lane in self.pool.lanes:
+            for size, count in (
+                lane.engine.metrics.request_sizes.snapshot().items()
+            ):
+                merged[size] = merged.get(size, 0) + count
+        return merged
+
+    def rebucket(self, force: bool = False) -> bool:
+        """One autoscale iteration: histogram -> ``suggest_buckets`` ->
+        build + warm replacements -> atomic swap -> old engines drain.
+        Returns True when a swap happened. Unforced calls act only on
+        enough evidence AND a changed proposal; ``force=True`` swaps
+        unconditionally (same buckets if no better proposal — the smoke
+        path and swap drills use this)."""
+        with self._swap_lock:
+            hist = self.observed_sizes()
+            observations = sum(hist.values())
+            proposal = self._buckets
+            if hist and (
+                force or observations >= MIN_REBUCKET_OBSERVATIONS
+            ):
+                proposal = suggest_buckets(
+                    hist, self._rebucket_k, max_bucket=self._buckets[-1]
+                )
+            if not force:
+                if observations < MIN_REBUCKET_OBSERVATIONS:
+                    return False
+                if proposal == self._buckets:
+                    return False
+            self.swap_engines(proposal)
+            return True
+
+    def swap_engines(self, buckets: Sequence[int]) -> None:
+        """Build + warm one replacement engine per lane with ``buckets``
+        and atomically swap them in (in-flight windows finish on the old
+        engines; queued and future requests use the new ones)."""
+        buckets = tuple(sorted(set(int(b) for b in buckets)))
+        with self._swap_lock:
+            self.pool.swap(
+                self._factory_for(buckets),
+                warmup_example=self._warmup_example,
+            )
+            self._buckets = buckets
+
+    def _maintenance_loop(self, interval_s: float) -> None:
+        while not self._maint_stop.wait(interval_s):
+            try:
+                if self.rebucket():
+                    logger.info(
+                        "gateway %s rebucketed to %s",
+                        self.name, self._buckets,
+                    )
+            except Exception:
+                # the loop must survive a failed proposal/build — the
+                # old engines keep serving either way
+                logger.exception("gateway %s rebucket failed", self.name)
+
+    # -- shutdown ----------------------------------------------------------
+
+    def close(self, timeout: Optional[float] = 10.0) -> None:
+        """Graceful drain: flip readiness, stop admitting (typed
+        ``Overloaded('closed')`` for new arrivals), drain the admission
+        queue into the lanes, flush every micro-batcher, and stop the
+        maintenance loop. Already-admitted requests resolve. Safe to
+        call concurrently: every caller returns only once the drain has
+        finished (the SIGTERM/`/drain` thread and the serve loop's own
+        close must not race the process exit past in-flight work)."""
+        with self._close_lock:
+            first = not self._closed
+            self._closed = True
+        if not first:
+            self._drained.wait(timeout)
+            return
+        self._maint_stop.set()
+        self.admission.close(timeout=timeout)
+        self.pool.close(timeout=timeout)
+        if self._maint is not None:
+            self._maint.join(timeout=1.0)
+        self._drained.set()
+        logger.info("gateway %s drained and closed", self.name)
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT -> graceful drain (main thread only; serving
+        CLIs call this, libraries shouldn't)."""
+
+        def handle(signum, frame):
+            logger.info(
+                "gateway %s: signal %d, draining", self.name, signum
+            )
+            threading.Thread(
+                target=self.close, name=f"keystone-{self.name}-drain",
+                daemon=True,
+            ).start()
+
+        signal.signal(signal.SIGTERM, handle)
+        signal.signal(signal.SIGINT, handle)
+
+    def __enter__(self) -> "Gateway":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+__all__ = ["Gateway", "Overloaded", "MIN_REBUCKET_OBSERVATIONS"]

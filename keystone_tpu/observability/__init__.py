@@ -4,10 +4,12 @@ KeystoneML's operator decisions (auto-caching, solver selection) run on
 *measured* profiles; this package gives the runtime the same treatment:
 
 - ``MetricsRegistry`` (registry.py): process-global catalogue of named,
-  labeled counters / gauges / latency summaries, built on the
+  labeled counters / gauges / latency summaries / native histograms
+  (``RegistryHistogram``: Prometheus ``le`` buckets that aggregate
+  exactly across scrapes and replicas), built on the
   ``Counter``/``LatencyRecorder`` primitives in ``utils/profiling.py``.
   ``ServingMetrics`` registers itself here; the executor, auto-cache
-  profiler, and ``PhaseTimer`` publish here.
+  profiler, ``PhaseTimer``, and the request gateway publish here.
 - ``Tracer`` (tracing.py): Dapper-style spans with parent links and a
   bounded ring of recent spans; Chrome trace-event JSON export for
   chrome://tracing / Perfetto. Disabled by default (one attribute read
@@ -30,8 +32,10 @@ from keystone_tpu.observability.admin import (
     stop_admin_server,
 )
 from keystone_tpu.observability.registry import (
+    DEFAULT_HISTOGRAM_BUCKETS,
     MetricFamily,
     MetricsRegistry,
+    RegistryHistogram,
     Sample,
     get_global_registry,
     reset_global_registry,
@@ -46,8 +50,10 @@ from keystone_tpu.observability.tracing import (
 
 __all__ = [
     "AdminServer",
+    "DEFAULT_HISTOGRAM_BUCKETS",
     "MetricFamily",
     "MetricsRegistry",
+    "RegistryHistogram",
     "Sample",
     "Span",
     "Tracer",

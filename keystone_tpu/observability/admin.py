@@ -1,10 +1,13 @@
 """Admin HTTP endpoint: ``/metrics``, ``/varz``, ``/healthz``, ``/tracez``.
 
-A stdlib ``http.server`` on a background daemon thread — nothing to
+Built on the shared scaffolding in ``observability/httpd.py`` — a
+stdlib ``http.server`` on a background daemon thread, nothing to
 install, nothing running unless ``AdminServer.start()`` (or the
 ``--admin-port`` CLI flag) is called, zero overhead when off. Routes:
 
-- ``GET /healthz``  -> ``ok`` (liveness probe)
+- ``GET /healthz``  -> ``ok`` (liveness probe; the gateway's
+  ``/readyz`` is the READINESS signal — a draining process is alive
+  but not ready)
 - ``GET /metrics``  -> Prometheus text exposition v0.0.4 of the global
   (or injected) ``MetricsRegistry`` — scrape target for Prometheus /
   the autoscaler
@@ -20,14 +23,13 @@ that).
 
 from __future__ import annotations
 
-import json
 import logging
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 from keystone_tpu.observability import prometheus
+from keystone_tpu.observability.httpd import BackgroundServer, JsonHandler
 from keystone_tpu.observability.registry import (
     MetricsRegistry,
     get_global_registry,
@@ -37,40 +39,26 @@ from keystone_tpu.observability.tracing import Tracer, get_tracer
 logger = logging.getLogger(__name__)
 
 
-class _Handler(BaseHTTPRequestHandler):
-    # injected per-server via the `server` attribute
-    def _send(self, code: int, body: bytes, content_type: str) -> None:
-        self.send_response(code)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _send_json(self, obj, code: int = 200) -> None:
-        self._send(
-            code,
-            json.dumps(obj, indent=1, default=str).encode("utf-8"),
-            "application/json; charset=utf-8",
-        )
-
+class _Handler(JsonHandler):
+    # routing state injected per-server via the `server` attribute
     def do_GET(self) -> None:  # noqa: N802 (stdlib handler API)
         url = urlparse(self.path)
         registry: MetricsRegistry = self.server.registry  # type: ignore
         tracer: Tracer = self.server.tracer  # type: ignore
         try:
             if url.path == "/healthz":
-                self._send(200, b"ok\n", "text/plain; charset=utf-8")
+                self._send_text(200, "ok\n")
             elif url.path == "/metrics":
                 body = prometheus.render(registry.collect())
                 self._send(
                     200, body.encode("utf-8"), prometheus.CONTENT_TYPE
                 )
             elif url.path == "/varz":
-                self._send_json(registry.varz())
+                self._send_json(registry.varz(), indent=1)
             elif url.path == "/tracez":
                 q = parse_qs(url.query)
                 if q.get("format", [""])[0] == "chrome":
-                    self._send_json(tracer.to_chrome_trace())
+                    self._send_json(tracer.to_chrome_trace(), indent=1)
                 else:
                     n = int(q["n"][0]) if "n" in q else None
                     self._send_json(
@@ -79,30 +67,27 @@ class _Handler(BaseHTTPRequestHandler):
                             "spans": [
                                 s.to_dict() for s in tracer.recent(n)
                             ],
-                        }
+                        },
+                        indent=1,
                     )
             else:
-                self._send(
+                self._send_text(
                     404,
-                    b"not found; try /metrics /varz /healthz /tracez\n",
-                    "text/plain; charset=utf-8",
+                    "not found; try /metrics /varz /healthz /tracez\n",
                 )
         except Exception as e:  # a broken collector must not kill the
             # serving thread — report it to the scraper instead
             logger.exception("admin endpoint error for %s", self.path)
-            self._send(
-                500, f"error: {e}\n".encode("utf-8"),
-                "text/plain; charset=utf-8",
-            )
-
-    def log_message(self, format, *args):  # quiet: scrapes every few
-        logger.debug("admin: " + format, *args)  # seconds otherwise spam
+            self._send_text(500, f"error: {e}\n")
 
 
-class AdminServer:
+class AdminServer(BackgroundServer):
     """The background admin endpoint. ``start()`` binds and serves on a
     daemon thread; ``stop()`` shuts down cleanly. Usable as a context
     manager."""
+
+    handler_cls = _Handler
+    thread_name = "keystone-admin-http"
 
     def __init__(
         self,
@@ -111,57 +96,13 @@ class AdminServer:
         registry: Optional[MetricsRegistry] = None,
         tracer: Optional[Tracer] = None,
     ):
-        self._requested = (host, port)
+        super().__init__(port=port, host=host)
         self.registry = registry if registry is not None else get_global_registry()
         self.tracer = tracer if tracer is not None else get_tracer()
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
 
-    @property
-    def port(self) -> int:
-        if self._httpd is None:
-            raise RuntimeError("AdminServer not started")
-        return self._httpd.server_address[1]
-
-    @property
-    def host(self) -> str:
-        return self._requested[0]
-
-    def url(self, path: str = "/") -> str:
-        return f"http://{self.host}:{self.port}{path}"
-
-    def start(self) -> "AdminServer":
-        if self._httpd is not None:
-            return self
-        httpd = ThreadingHTTPServer(self._requested, _Handler)
-        httpd.daemon_threads = True
-        httpd.registry = self.registry  # type: ignore[attr-defined]
-        httpd.tracer = self.tracer  # type: ignore[attr-defined]
-        self._httpd = httpd
-        self._thread = threading.Thread(
-            target=httpd.serve_forever,
-            name="keystone-admin-http",
-            daemon=True,
-        )
-        self._thread.start()
-        logger.info("admin endpoint serving on %s", self.url())
-        return self
-
-    def stop(self) -> None:
-        if self._httpd is None:
-            return
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        self._httpd = None
-        self._thread = None
-
-    def __enter__(self) -> "AdminServer":
-        return self.start()
-
-    def __exit__(self, *exc) -> None:
-        self.stop()
+    def _configure(self, httpd) -> None:
+        httpd.registry = self.registry
+        httpd.tracer = self.tracer
 
 
 _server: Optional[AdminServer] = None

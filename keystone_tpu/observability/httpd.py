@@ -1,0 +1,114 @@
+"""Shared stdlib-HTTP scaffolding for the background endpoints.
+
+The admin plane (``observability/admin.py``) and the gateway frontend
+(``gateway/http.py``) are both the same shape: a ``ThreadingHTTPServer``
+on a daemon thread, bound to localhost by default, ``port=0`` for an
+ephemeral port, JSON/text responses with explicit Content-Length, and a
+clean ``start()``/``stop()``/context-manager lifecycle. This module is
+that shape, once — a fix to binding, shutdown, or response framing
+lands in both endpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class JsonHandler(BaseHTTPRequestHandler):
+    """Response helpers + quiet logging shared by the endpoint
+    handlers (scrapes/probes hit every few seconds; request logs go to
+    DEBUG instead of stderr)."""
+
+    def _send(self, code: int, body: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(
+        self, obj, code: int = 200, indent: Optional[int] = None
+    ) -> None:
+        self._send(
+            code,
+            json.dumps(obj, indent=indent, default=str).encode("utf-8"),
+            "application/json; charset=utf-8",
+        )
+
+    def _send_text(self, code: int, text: str) -> None:
+        self._send(
+            code, text.encode("utf-8"), "text/plain; charset=utf-8"
+        )
+
+    def log_message(self, format, *args):  # noqa: A002 (stdlib API)
+        logger.debug("%s: " + format, type(self).__module__, *args)
+
+
+class BackgroundServer:
+    """A ``ThreadingHTTPServer`` + daemon serve thread behind
+    ``start()``/``stop()``. Subclasses set ``handler_cls`` and
+    ``thread_name`` and attach their routing state to the live server
+    object in ``_configure()``."""
+
+    handler_cls = JsonHandler
+    thread_name = "keystone-http"
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._requested = (host, port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _configure(self, httpd: ThreadingHTTPServer) -> None:
+        """Attach handler-visible state (registries, gateways, ...) to
+        ``httpd`` before the serve thread starts."""
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            raise RuntimeError(f"{type(self).__name__} not started")
+        return self._httpd.server_address[1]
+
+    @property
+    def host(self) -> str:
+        return self._requested[0]
+
+    def url(self, path: str = "/") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def start(self) -> "BackgroundServer":
+        if self._httpd is not None:
+            return self
+        httpd = ThreadingHTTPServer(self._requested, self.handler_cls)
+        httpd.daemon_threads = True
+        self._configure(httpd)
+        self._httpd = httpd
+        self._thread = threading.Thread(
+            target=httpd.serve_forever,
+            name=self.thread_name,
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info("%s serving on %s", type(self).__name__, self.url())
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._httpd = None
+        self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

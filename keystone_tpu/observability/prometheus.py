@@ -58,6 +58,17 @@ def escape_help(text: str) -> str:
     return str(text).replace("\\", r"\\").replace("\n", r"\n")
 
 
+def format_le(bound: float) -> str:
+    """A histogram bucket bound as its canonical ``le`` label value
+    (what promtool emits: ``0.005``, ``1``, ``2.5``, ``+Inf``) so the
+    same bound always produces the same series identity."""
+    if math.isinf(bound):
+        return "+Inf" if bound > 0 else "-Inf"
+    if float(bound).is_integer():
+        return str(int(bound))
+    return repr(float(bound))
+
+
 def format_value(v: float) -> str:
     if math.isnan(v):
         return "NaN"

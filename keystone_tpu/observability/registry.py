@@ -23,7 +23,9 @@ an engine's lifetime) that yields families at scrape time.
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
+import math
 import threading
 from typing import (
     Callable,
@@ -42,6 +44,13 @@ LabelValues = Tuple[str, ...]
 # quantiles a latency summary exports (matches LatencyRecorder's
 # p50/p95/p99 surface; Prometheus summary convention)
 SUMMARY_QUANTILES = (0.5, 0.95, 0.99)
+
+# default `le` bounds of a RegistryHistogram, tuned for request/queue
+# latencies in seconds: sub-ms through 10s, roughly 2.5x apart
+DEFAULT_HISTOGRAM_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
 
 
 @dataclasses.dataclass
@@ -217,11 +226,98 @@ class RegistrySummary(_Metric):
         return MetricFamily(self.name, self.mtype, self.help, samples)
 
 
+class RegistryHistogram(_Metric):
+    """Native Prometheus histogram: cumulative ``le``-bucket counts plus
+    ``_sum``/``_count`` per label set.
+
+    Unlike ``RegistrySummary`` (whose client-side quantiles cannot be
+    aggregated across scrapes or instances), histogram buckets ADD —
+    ``histogram_quantile(0.99, sum by (le) (rate(...)))`` is exact
+    across every gateway/lane/host publishing the same family, which is
+    why the gateway's queue-wait and request-latency series use this
+    type. Observation is O(log buckets) (one bisect + one lock)."""
+
+    mtype = "histogram"
+
+    def __init__(
+        self,
+        name,
+        help,
+        labelnames,
+        buckets: Sequence[float] = DEFAULT_HISTOGRAM_BUCKETS,
+    ):
+        super().__init__(name, help, labelnames)
+        bounds = tuple(float(b) for b in buckets)
+        if not bounds:
+            raise ValueError(f"histogram {name} needs at least one bucket")
+        if not all(math.isfinite(b) for b in bounds):
+            # +Inf is implicit (collect() always appends it); accepting
+            # an explicit inf bound would emit a duplicate le="+Inf"
+            # series, which Prometheus rejects scrape-wide
+            raise ValueError(
+                f"histogram {name} buckets must be finite (+Inf is "
+                f"implicit): {bounds}"
+            )
+        if list(bounds) != sorted(set(bounds)):
+            raise ValueError(
+                f"histogram {name} buckets must be strictly ascending: "
+                f"{bounds}"
+            )
+        self.bounds = bounds
+        # per label set: [per-bound counts..., +Inf overflow], sum
+        self._cells: Dict[LabelValues, Tuple[List[int], List[float]]] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, labels: Optional[LabelValues] = None):
+        values = self._check(labels)
+        value = float(value)
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            cell = self._cells.get(values)
+            if cell is None:
+                cell = self._cells[values] = (
+                    [0] * (len(self.bounds) + 1), [0.0],
+                )
+            cell[0][idx] += 1
+            cell[1][0] += value
+
+    def get_count(self, labels: Optional[LabelValues] = None) -> int:
+        values = self._check(labels)
+        with self._lock:
+            cell = self._cells.get(values)
+            return sum(cell[0]) if cell else 0
+
+    def collect(self) -> MetricFamily:
+        with self._lock:
+            cells = {
+                k: (list(counts), totals[0])
+                for k, (counts, totals) in self._cells.items()
+            }
+        # local import: prometheus.py imports MetricFamily from here
+        from keystone_tpu.observability.prometheus import format_le
+
+        samples: List[Sample] = []
+        for values, (counts, total) in sorted(cells.items()):
+            base = _label_dict(self.labelnames, values)
+            cum = 0
+            for bound, c in zip(self.bounds, counts):
+                cum += c
+                samples.append(
+                    Sample("_bucket", {**base, "le": format_le(bound)}, cum)
+                )
+            cum += counts[-1]
+            samples.append(Sample("_bucket", {**base, "le": "+Inf"}, cum))
+            samples.append(Sample("_count", base, cum))
+            samples.append(Sample("_sum", base, total))
+        return MetricFamily(self.name, self.mtype, self.help, samples)
+
+
 class MetricsRegistry:
     """The named catalogue. ``counter``/``gauge``/``gauge_func``/
-    ``summary`` are get-or-create: re-registering the same name with the
-    same type and labelnames returns the existing metric (subsystems in
-    different modules can share a family); a mismatch raises."""
+    ``summary``/``histogram`` are get-or-create: re-registering the same
+    name with the same type and labelnames returns the existing metric
+    (subsystems in different modules can share a family); a mismatch
+    raises."""
 
     def __init__(self):
         self._metrics: Dict[str, _Metric] = {}
@@ -280,6 +376,23 @@ class MetricsRegistry:
         return self._get_or_create(
             RegistrySummary, name, help, labelnames, window=window
         )
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        labelnames: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_HISTOGRAM_BUCKETS,
+    ) -> RegistryHistogram:
+        hist = self._get_or_create(
+            RegistryHistogram, name, help, labelnames, buckets=buckets
+        )
+        if hist.bounds != tuple(float(b) for b in buckets):
+            raise ValueError(
+                f"histogram {name!r} already registered with buckets "
+                f"{hist.bounds}, asked for {tuple(buckets)}"
+            )
+        return hist
 
     def register_collector(
         self, fn: Callable[[], Optional[Iterable[MetricFamily]]]

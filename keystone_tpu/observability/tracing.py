@@ -112,14 +112,20 @@ class Tracer:
             stack = self._local.stack = []
         return stack
 
-    def start_span(self, name: str, **attrs: Any):
+    def start_span(
+        self, name: str, parent_id: Optional[int] = None, **attrs: Any
+    ):
         """Explicit API (use ``span()`` where a ``with`` block fits).
-        The new span's parent is this thread's innermost open span."""
+        The new span's parent is this thread's innermost open span,
+        unless ``parent_id`` pins it explicitly — the cross-thread case,
+        e.g. a micro-batch window on the dispatcher thread parenting
+        under the ``gateway.admit`` span of the request that opened it."""
         if not self.enabled:
             return _NULL_SPAN
         stack = self._stack()
-        parent = stack[-1].span_id if stack else None
-        span = _ActiveSpan(name, parent, attrs)
+        if parent_id is None:
+            parent_id = stack[-1].span_id if stack else None
+        span = _ActiveSpan(name, parent_id, attrs)
         stack.append(span)
         return span
 
@@ -143,19 +149,24 @@ class Tracer:
         return done
 
     @contextlib.contextmanager
-    def _span_cm(self, name: str, attrs: Dict[str, Any]):
-        span = self.start_span(name, **attrs)
+    def _span_cm(
+        self, name: str, parent_id: Optional[int], attrs: Dict[str, Any]
+    ):
+        span = self.start_span(name, parent_id=parent_id, **attrs)
         try:
             yield span
         finally:
             self.end_span(span)
 
-    def span(self, name: str, **attrs: Any):
+    def span(
+        self, name: str, parent_id: Optional[int] = None, **attrs: Any
+    ):
         """``with tracer.span("serving.dispatch", bucket=8):`` — records
-        nothing when the tracer is disabled."""
+        nothing when the tracer is disabled. ``parent_id`` pins the
+        parent explicitly (cross-thread chains)."""
         if not self.enabled:
             return _NULL_SPAN
-        return self._span_cm(name, attrs)
+        return self._span_cm(name, parent_id, attrs)
 
     def current_span(self):
         stack = getattr(self._local, "stack", None)

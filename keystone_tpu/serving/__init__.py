@@ -10,7 +10,9 @@ production inference engine:
   with input-buffer donation and an optional mesh-sharded variant.
 - ``MicroBatcher`` (batching.py): adaptive micro-batching — a
   thread-safe queue that coalesces single-example ``submit()`` requests
-  into the smallest covering bucket under a max-latency deadline.
+  into spec-homogeneous windows (interleaved request streams with
+  different shapes each get their own) under a max-latency deadline,
+  with a ``swap_engine()`` hook for zero-downtime engine replacement.
 - ``ServingMetrics`` (metrics.py): per-bucket compile/dispatch counts,
   request-size histogram, queue depth, p50/p95/p99 latency, windowed
   examples/sec — auto-registered into the process-global
@@ -23,7 +25,9 @@ production inference engine:
 
 Persistent-compile-cache setup lives in
 ``keystone_tpu.parallel.runtime.setup_compilation_cache`` (a restarted
-server warms from disk instead of recompiling).
+server warms from disk instead of recompiling). The request plane in
+FRONT of these engines — admission control, replica lanes, live
+re-bucketing, HTTP — is ``keystone_tpu.gateway``.
 """
 
 from keystone_tpu.serving.autoscale import padding_waste, suggest_buckets

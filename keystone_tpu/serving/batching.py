@@ -10,11 +10,24 @@ until the largest bucket fills, whichever first) into ONE padded
 bucket dispatch through a ``CompiledPipeline``, then resolves each
 request's future with its own row of the result.
 
+Pending requests are segregated by example spec (pytree structure +
+per-leaf shape/dtype): interleaved well-formed streams with different
+shapes each coalesce into their own spec-homogeneous windows instead of
+one stream's requests spuriously erroring against the other's — the
+dispatcher always drains the spec whose OLDEST request is closest to
+its deadline first, so segregation never starves a stream.
+
 Latency/throughput contract: a lone request waits at most ``max_delay``
 before dispatching solo; under load, dispatches fill toward
 ``max_batch`` and per-request latency approaches the bucket's compiled
 execution time. Queue depth, coalesce sizes, and request p50/p99 are
 recorded on the shared ``ServingMetrics``.
+
+``swap_engine()`` is the request plane's live re-bucket hook
+(gateway/lifecycle.py): it atomically replaces the engine behind the
+batcher — queued and future windows dispatch through the replacement,
+the window already in flight completes on the old engine, and no
+request is dropped or reordered.
 """
 
 from __future__ import annotations
@@ -34,6 +47,9 @@ from keystone_tpu.serving.engine import CompiledPipeline
 
 logger = logging.getLogger(__name__)
 
+# (example, future, enqueue time, optional parent span id)
+_Entry = Tuple[Any, Future, float, Optional[int]]
+
 
 class MicroBatcher:
     def __init__(
@@ -44,6 +60,9 @@ class MicroBatcher:
     ):
         self.engine = engine
         self.max_delay = max_delay_ms / 1e3
+        # an explicit max_batch is pinned across engine swaps; the
+        # default tracks whatever the current engine's largest bucket is
+        self._max_batch_pinned = max_batch is not None
         self.max_batch = max_batch or engine.max_bucket
         if self.max_batch > engine.max_bucket:
             raise ValueError(
@@ -51,14 +70,13 @@ class MicroBatcher:
                 f"bucket {engine.max_bucket}"
             )
         self.metrics = engine.metrics
-        # spec (treedef + leaf shapes/dtypes) of the CURRENT pending
-        # window, set by the window's first submit and cleared when the
-        # window drains: a mismatched request is rejected AT submit()
-        # so one ragged example can't fail a coalesced window of
-        # unrelated requests at stack time — and a bad request poisons
-        # at most its own window, never the batcher's lifetime
-        self._window_spec = None
-        self._pending: List[Tuple[Any, Future, float]] = []
+        # pending requests segregated by spec (treedef + leaf
+        # shapes/dtypes): each spec coalesces into its own windows, so
+        # interleaved streams of different shapes never poison each
+        # other — a bad request fails only its own spec's window at
+        # dispatch (stack/trace time), never a co-tenant stream's
+        self._pending: dict = {}  # spec -> List[_Entry], insertion-ordered
+        self._n_pending = 0
         self._cond = threading.Condition()
         self._closed = False
         self._worker = threading.Thread(
@@ -82,27 +100,49 @@ class MicroBatcher:
         leaves, treedef = jax.tree_util.tree_flatten(example)
         return treedef, tuple(self._leaf_spec(a) for a in leaves)
 
-    def submit(self, example: Any) -> "Future":
+    def submit(
+        self, example: Any, parent_span_id: Optional[int] = None
+    ) -> "Future":
         """Enqueue one example (a pytree WITHOUT the leading batch axis);
         the returned future resolves to that example's pipeline output.
-        Raises ``ValueError`` when the example's structure/shape/dtype
-        disagrees with the current window's first example."""
+        ``parent_span_id`` threads an upstream span (e.g. the gateway's
+        ``gateway.admit``) through to the window's ``microbatch.coalesce``
+        span, which runs on the dispatcher thread."""
         spec = self._example_spec(example)
         fut: Future = Future()
         with self._cond:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
-            if not self._pending:
-                self._window_spec = spec
-            elif spec != self._window_spec:
-                raise ValueError(
-                    f"example spec {spec} does not match this window's "
-                    f"spec {self._window_spec}"
-                )
-            self._pending.append((example, fut, time.perf_counter()))
-            self.metrics.set_queue_depth(len(self._pending))
+            self._pending.setdefault(spec, []).append(
+                (example, fut, time.perf_counter(), parent_span_id)
+            )
+            self._n_pending += 1
+            self.metrics.set_queue_depth(self._n_pending)
             self._cond.notify()
         return fut
+
+    def swap_engine(self, engine: CompiledPipeline) -> CompiledPipeline:
+        """Atomically replace the engine behind this batcher and return
+        the old one. Queued and future windows dispatch through the new
+        engine; a window already in flight completes on the old engine
+        (the caller lets it drain by dropping its reference — in-flight
+        futures resolve from it normally). No request is dropped."""
+        with self._cond:
+            old, self.engine = self.engine, engine
+            self.metrics = engine.metrics
+            if not self._max_batch_pinned:
+                self.max_batch = engine.max_bucket
+            elif self.max_batch > engine.max_bucket:
+                # engine.apply chunks oversized windows through its
+                # largest bucket, so a too-small replacement degrades
+                # (extra dispatches per window) instead of failing swaps
+                logger.warning(
+                    "swap_engine: pinned max_batch %d exceeds the new "
+                    "engine's largest bucket %d; windows will chunk",
+                    self.max_batch, engine.max_bucket,
+                )
+            self._cond.notify()
+        return old
 
     def close(self, timeout: Optional[float] = 10.0) -> None:
         """Flush pending requests and stop the dispatcher thread. If the
@@ -127,9 +167,12 @@ class MicroBatcher:
         # died on an unexpected error outside _dispatch's catch — fail
         # those futures rather than hang their waiters
         with self._cond:
-            stranded = self._pending[:]
-            del self._pending[:]
-        for _, fut, _ in stranded:
+            stranded = [
+                e for entries in self._pending.values() for e in entries
+            ]
+            self._pending.clear()
+            self._n_pending = 0
+        for _, fut, _, _ in stranded:
             if not fut.done():
                 fut.set_exception(
                     RuntimeError("MicroBatcher closed before dispatch")
@@ -143,45 +186,65 @@ class MicroBatcher:
 
     # -- dispatcher side ---------------------------------------------------
 
-    def _take_batch(self) -> List[Tuple[Any, Future, float]]:
-        """Block until there's work, then wait out the oldest request's
-        deadline (or a full batch, or close) and take up to max_batch."""
+    def _take_batch(self) -> Tuple[List[_Entry], Optional[CompiledPipeline]]:
+        """Block until there's work, pick the spec whose oldest request
+        is nearest its deadline, wait that deadline out (or a full
+        window, or close), and take up to max_batch of that spec."""
         with self._cond:
-            while not self._pending and not self._closed:
+            while not self._n_pending and not self._closed:
                 self._cond.wait()
-            if not self._pending:
-                return []  # closed and drained
-            deadline = self._pending[0][2] + self.max_delay
+            if not self._n_pending:
+                return [], None  # closed and drained
+            # the spec with the OLDEST head request dispatches first:
+            # its deadline is the earliest, and age-order across specs
+            # means no stream waits behind a younger one
+            spec = min(
+                self._pending, key=lambda s: self._pending[s][0][2]
+            )
+            deadline = self._pending[spec][0][2] + self.max_delay
             while (
-                len(self._pending) < self.max_batch
+                len(self._pending[spec]) < self.max_batch
                 and not self._closed
             ):
                 remaining = deadline - time.perf_counter()
                 if remaining <= 0 or not self._cond.wait(remaining):
                     break
-            batch = self._pending[: self.max_batch]
-            del self._pending[: len(batch)]
-            self.metrics.set_queue_depth(len(self._pending))
-            return batch
+            entries = self._pending[spec]
+            batch = entries[: self.max_batch]
+            del entries[: len(batch)]
+            if not entries:
+                del self._pending[spec]
+            self._n_pending -= len(batch)
+            self.metrics.set_queue_depth(self._n_pending)
+            # snapshot under the lock so a concurrent swap_engine cannot
+            # split a window across two engines
+            return batch, self.engine
 
     def _loop(self) -> None:
         while True:
-            batch = self._take_batch()
+            batch, engine = self._take_batch()
             if not batch:
                 return
-            self._dispatch(batch)
+            self._dispatch(batch, engine)
 
-    def _dispatch(self, batch: List[Tuple[Any, Future, float]]) -> None:
-        examples = [ex for ex, _, _ in batch]
-        futures = [f for _, f, _ in batch]
-        enqueued = [t for _, _, t in batch]
-        self.metrics.record_coalesce(len(batch))
+    def _dispatch(
+        self, batch: List[_Entry], engine: CompiledPipeline
+    ) -> None:
+        examples = [ex for ex, _, _, _ in batch]
+        futures = [f for _, f, _, _ in batch]
+        enqueued = [t for _, _, t, _ in batch]
+        metrics = engine.metrics
+        metrics.record_coalesce(len(batch))
         # the engine's serving.dispatch span nests under this one, so
-        # /tracez shows coalesce -> dispatch parent links per window
+        # /tracez shows coalesce -> dispatch parent links per window;
+        # the window's parent is the FIRST request's upstream span (the
+        # gateway.admit that has waited longest), linking the admit ->
+        # coalesce -> dispatch chain across threads
         try:
             with get_tracer().span(
                 "microbatch.coalesce",
-                engine=self.engine.name,
+                parent_id=batch[0][3],
+                engine=engine.name,
                 window=len(batch),
             ):
                 def stack(*xs):
@@ -193,7 +256,7 @@ class MicroBatcher:
                     return np.stack([np.asarray(x) for x in xs])
 
                 stacked = jax.tree_util.tree_map(stack, *examples)
-                out = self.engine.apply(stacked, sync=True, owned=True)
+                out = engine.apply(stacked, sync=True, owned=True)
             done = time.perf_counter()
             for i, fut in enumerate(futures):
                 row = jax.tree_util.tree_map(lambda a, i=i: a[i], out)
@@ -202,7 +265,7 @@ class MicroBatcher:
                 except Exception:
                     continue  # caller cancelled this request; the rest
                     # of the batch must still get their results
-                self.metrics.record_request(done - enqueued[i])
+                metrics.record_request(done - enqueued[i])
         except Exception as e:  # resolve, never hang callers
             for fut in futures:
                 if not fut.done():

@@ -1,4 +1,4 @@
-"""Serving benchmarks: the three tracked serving metrics.
+"""Serving benchmarks: the tracked serving metrics.
 
 - ``serving_cold_vs_warm_latency`` — one shape, cold (trace + XLA
   compile + dispatch) vs warm (compiled dispatch) latency through the
@@ -12,6 +12,13 @@
 - ``serving_microbatch_p99`` — p99 end-to-end request latency of
   concurrent single-example ``submit()``s coalesced by the
   ``MicroBatcher`` under a small deadline.
+- ``serving_gateway_p99`` — the same concurrent single-example load
+  pushed through the FULL request plane (``keystone_tpu/gateway/``:
+  admission -> lane routing -> micro-batch -> engine); the delta over
+  ``serving_microbatch_p99`` prices the gateway layer.
+- ``serving_swap_blip`` — p99 latency of requests issued while a forced
+  live engine swap runs under steady load (zero failures asserted) —
+  the cost of closing the autoscale loop live.
 
 Callable standalone (``python -m keystone_tpu serve-bench``) or from
 the repo-level ``bench.py`` which passes its own ``emit`` so rows land
@@ -201,6 +208,143 @@ def bench_microbatch(
     )
 
 
+def bench_gateway(
+    emit, fitted, buckets: Sequence[int], d: int,
+    n_requests: int = 256, n_threads: int = 8, n_lanes: int = 2,
+) -> None:
+    """``serving_gateway_p99`` — p99 end-to-end latency through the FULL
+    request plane (admission queue -> lane routing -> micro-batch ->
+    engine) under concurrent load; comparable against the bare
+    ``serving_microbatch_p99`` row to price the gateway layer."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.gateway import Gateway
+
+    from keystone_tpu.gateway.admission import Overloaded
+
+    rng = np.random.default_rng(4)
+    examples = rng.standard_normal((n_requests, d)).astype(np.float32)
+    with Gateway(
+        fitted, buckets=buckets, n_lanes=n_lanes, max_delay_ms=2.0,
+        warmup_example=jnp.zeros((d,), jnp.float32),
+        name="bench-gateway",
+    ) as gw:
+        # each client thread times its own requests SYNCHRONOUSLY
+        # (submit -> result), so a latency is recorded exactly when its
+        # request resolves — no done-callback race — and a shed predict
+        # is counted instead of crashing the bench
+        latencies = []
+        lock = threading.Lock()
+        t0 = time.perf_counter()
+
+        def client(tid):
+            for i in range(tid, n_requests, n_threads):
+                t = time.perf_counter()
+                try:
+                    gw.predict(examples[i]).result(timeout=60)
+                except Overloaded:
+                    continue  # shows up in the shed counter
+                with lock:
+                    latencies.append(time.perf_counter() - t)
+
+        threads = [
+            threading.Thread(target=client, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        m = gw.metrics
+        if not latencies:
+            raise RuntimeError(
+                "gateway bench: every request was shed; summary="
+                + str(m.registry.varz().get(
+                    "keystone_gateway_shed_total"
+                ))
+            )
+        emit(
+            "serving_gateway_p99",
+            float(np.percentile(latencies, 99)) * 1e3, "ms",
+            extra={
+                "requests": n_requests,
+                "served": len(latencies),
+                "client_threads": n_threads,
+                "lanes": n_lanes,
+                "p50_ms": round(
+                    float(np.percentile(latencies, 50)) * 1e3, 3
+                ),
+                "requests_per_sec": round(len(latencies) / dt, 1),
+                "shed": int(m.outcome_count("shed")),
+                "errors": int(m.outcome_count("error")),
+                "retries": int(m.retry_count()),
+            },
+        )
+
+
+def bench_swap_blip(
+    emit, fitted, buckets: Sequence[int], d: int,
+    n_requests: int = 256, n_threads: int = 4,
+) -> None:
+    """``serving_swap_blip`` — p99 latency of requests issued WHILE a
+    forced live engine swap (build + warm + atomic re-point + drain)
+    runs under steady load, with the zero-failure requirement asserted;
+    the blip is the price of closing the autoscale loop live."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.gateway import Gateway
+
+    rng = np.random.default_rng(5)
+    examples = rng.standard_normal((n_requests, d)).astype(np.float32)
+    with Gateway(
+        fitted, buckets=buckets, n_lanes=2, max_delay_ms=2.0,
+        warmup_example=jnp.zeros((d,), jnp.float32),
+        name="bench-swap",
+    ) as gw:
+        latencies = [0.0] * n_requests
+        failures = [0]
+        swap_s = [0.0]
+
+        def client(tid):
+            for i in range(tid, n_requests, n_threads):
+                t = time.perf_counter()
+                try:
+                    gw.predict(examples[i]).result(timeout=60)
+                except Exception:
+                    failures[0] += 1
+                latencies[i] = time.perf_counter() - t
+
+        threads = [
+            threading.Thread(target=client, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        t0 = time.perf_counter()
+        gw.rebucket(force=True)  # the live swap, mid-load
+        swap_s[0] = time.perf_counter() - t0
+        for t in threads:
+            t.join()
+        assert failures[0] == 0, (
+            f"{failures[0]} requests failed across the live swap"
+        )
+        emit(
+            "serving_swap_blip",
+            float(np.percentile(latencies, 99)) * 1e3, "ms",
+            extra={
+                "requests": n_requests,
+                "p50_ms": round(
+                    float(np.percentile(latencies, 50)) * 1e3, 3
+                ),
+                "swap_wall_ms": round(swap_s[0] * 1e3, 1),
+                "swaps": int(gw.metrics.swap_count()),
+                "failures": failures[0],
+                "buckets_after": list(gw.buckets),
+            },
+        )
+
+
 def run_serving_benches(
     emit,
     d: int = 256,
@@ -212,6 +356,8 @@ def run_serving_benches(
     bench_cold_vs_warm(emit, fitted, buckets, d)
     bench_bucketed_throughput(emit, fitted, buckets, d)
     bench_microbatch(emit, fitted, buckets, d)
+    bench_gateway(emit, fitted, buckets, d)
+    bench_swap_blip(emit, fitted, buckets, d)
 
 
 def main(argv=None) -> int:

@@ -88,6 +88,19 @@ class PhaseTimer:
                 self._published[phase_name] = seconds
 
 
+def _interp_percentile(data, p: float) -> Optional[float]:
+    """Linear-interpolated percentile of ascending ``data`` (p in
+    [0, 100]); the ONE implementation ``percentile()`` and
+    ``snapshot()`` share so exporters can never disagree."""
+    if not data:
+        return None
+    rank = (p / 100.0) * (len(data) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(data) - 1)
+    frac = rank - lo
+    return data[lo] * (1.0 - frac) + data[hi] * frac
+
+
 class LatencyRecorder:
     """Thread-safe latency reservoir with percentile queries.
 
@@ -113,14 +126,8 @@ class LatencyRecorder:
     def percentile(self, p: float) -> Optional[float]:
         """p in [0, 100]; None until a sample exists."""
         with self._lock:
-            if not self._samples:
-                return None
             data = sorted(self._samples)
-        rank = (p / 100.0) * (len(data) - 1)
-        lo = int(rank)
-        hi = min(lo + 1, len(data) - 1)
-        frac = rank - lo
-        return data[lo] * (1.0 - frac) + data[hi] * frac
+        return _interp_percentile(data, p)
 
     @property
     def p50(self) -> Optional[float]:
@@ -148,22 +155,12 @@ class LatencyRecorder:
             count = self.count
             total = self.total
             data = sorted(self._samples)
-
-        def pct(p: float) -> Optional[float]:
-            if not data:
-                return None
-            rank = (p / 100.0) * (len(data) - 1)
-            lo = int(rank)
-            hi = min(lo + 1, len(data) - 1)
-            frac = rank - lo
-            return data[lo] * (1.0 - frac) + data[hi] * frac
-
         return {
             "count": count,
             "total": total,
-            "p50": pct(50.0),
-            "p95": pct(95.0),
-            "p99": pct(99.0),
+            "p50": _interp_percentile(data, 50.0),
+            "p95": _interp_percentile(data, 95.0),
+            "p99": _interp_percentile(data, 99.0),
         }
 
 

@@ -1,0 +1,8 @@
+import pytest
+
+from gateway_fixtures import make_fitted
+
+
+@pytest.fixture(scope="session")
+def fitted():
+    return make_fitted()

@@ -1,0 +1,43 @@
+"""Shared gateway test helpers: a tiny two-layer fitted pipeline (the
+same shape tests/serving uses) and its reference apply. A plain module
+(not conftest.py) so `import gateway_fixtures` is unambiguous in a
+full-suite run."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+from keystone_tpu.parallel.dataset import Dataset
+from keystone_tpu.workflow.api import Transformer
+
+D = 6
+
+
+@dataclasses.dataclass(eq=False)
+class Affine(Transformer):
+    W: object
+    b: object
+
+    def apply(self, x):
+        return jnp.tanh(x @ self.W + self.b)
+
+
+def make_fitted():
+    rng = np.random.default_rng(0)
+    w1 = jnp.asarray(rng.standard_normal((D, 8)).astype(np.float32))
+    w2 = jnp.asarray(rng.standard_normal((8, 3)).astype(np.float32))
+    pipe = Affine(w1, jnp.zeros(8, jnp.float32)).and_then(
+        Affine(w2, jnp.ones(3, jnp.float32))
+    )
+    return pipe.fit()
+
+
+def batch(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, D)).astype(np.float32)
+
+
+def reference(fitted, xs):
+    return np.asarray(
+        fitted.apply(Dataset.from_array(jnp.asarray(xs))).array()
+    )

@@ -1,0 +1,172 @@
+"""AdmissionController policy unit tests against a stub pool: bounded
+queue, typed sheds (queue_full / deadline / expired / closed), deadline
+propagation, and the queue-wait metrics."""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import pytest
+
+from keystone_tpu.gateway.admission import AdmissionController, Overloaded
+from keystone_tpu.gateway.metrics import GatewayMetrics
+from keystone_tpu.observability.registry import MetricsRegistry
+
+
+class FakePool:
+    """A pool whose capacity and completions the test controls."""
+
+    def __init__(self, capacity=0):
+        self.capacity = capacity
+        self.submitted = []
+        self._listeners = []
+        self._lock = threading.Lock()
+
+    def add_free_listener(self, fn):
+        self._listeners.append(fn)
+
+    def free_capacity(self):
+        return self.capacity
+
+    def total_load(self):
+        with self._lock:
+            return len([f for f in self.submitted if not f[1].done()])
+
+    def submit(self, example, parent_span_id=None):
+        fut = Future()
+        with self._lock:
+            self.submitted.append((example, fut))
+        return fut
+
+    def open_capacity(self, n=1_000_000):
+        self.capacity = n
+        for fn in self._listeners:
+            fn()
+
+    def resolve_all(self, value="ok"):
+        with self._lock:
+            pending = [f for _, f in self.submitted if not f.done()]
+        for f in pending:
+            f.set_result(value)
+
+
+def make_admission(pool, **kw):
+    metrics = GatewayMetrics(
+        registry=MetricsRegistry(), gateway=kw.pop("name", "test-gw")
+    )
+    return AdmissionController(pool, metrics=metrics, **kw), metrics
+
+
+def test_queue_full_sheds_with_typed_error():
+    pool = FakePool(capacity=0)  # nothing drains: queue must bound
+    adm, metrics = make_admission(pool, max_pending=2)
+    try:
+        adm.submit("a")
+        adm.submit("b")
+        with pytest.raises(Overloaded) as e:
+            adm.submit("c")
+        assert e.value.reason == "queue_full"
+        assert e.value.queue_depth == 2
+        assert metrics.shed_count("queue_full") == 1
+        assert metrics.outcome_count("shed") == 1
+    finally:
+        pool.open_capacity()
+        adm.close()
+        pool.resolve_all()
+
+
+def test_routes_when_capacity_frees_and_records_queue_wait():
+    pool = FakePool(capacity=0)
+    adm, metrics = make_admission(pool)
+    fut = adm.submit("x")
+    time.sleep(0.05)
+    assert not pool.submitted  # held in the admission queue
+    pool.open_capacity()
+    deadline = time.perf_counter() + 5
+    while not pool.submitted and time.perf_counter() < deadline:
+        time.sleep(0.005)
+    assert pool.submitted and pool.submitted[0][0] == "x"
+    pool.resolve_all("result")
+    assert fut.result(timeout=5) == "result"
+    assert metrics.outcome_count("ok") == 1
+    # the ~50ms queue hold landed in the queue-wait histogram
+    assert metrics.queue_wait.get_count(("test-gw",)) == 1
+    assert metrics.request_latency.get_count(("test-gw",)) == 1
+    adm.close()
+
+
+def test_deadline_expired_in_queue_is_shed_at_handoff():
+    pool = FakePool(capacity=0)
+    adm, metrics = make_admission(pool)
+    fut = adm.submit("x", deadline_ms=30.0)
+    time.sleep(0.1)  # deadline dies while queued
+    pool.open_capacity()
+    with pytest.raises(Overloaded) as e:
+        fut.result(timeout=5)
+    assert e.value.reason == "expired"
+    assert metrics.shed_count("expired") == 1
+    assert not pool.submitted  # no engine time spent on a dead request
+    adm.close()
+
+
+def test_estimated_wait_sheds_undeliverable_deadlines():
+    pool = FakePool(capacity=0)
+    adm, metrics = make_admission(pool, max_pending=1000)
+    # seed the completion-rate estimator: 10 completions over ~1s
+    # -> ~10/s; with 50 queued the estimated wait is ~5s
+    now = time.perf_counter()
+    with adm._comp_lock:
+        for i in range(10):
+            adm._completions.append(now - 1.0 + i * 0.1)
+    for _ in range(50):
+        adm.submit("bulk")  # no deadline: always admitted
+    est = adm.estimated_wait_s()
+    assert est is not None and est > 1.0
+    with pytest.raises(Overloaded) as e:
+        adm.submit("urgent", deadline_ms=10.0)
+    assert e.value.reason == "deadline"
+    assert e.value.est_wait_s == pytest.approx(est, rel=0.5)
+    assert metrics.shed_count("deadline") == 1
+    # a deadline the estimate CAN meet is admitted
+    adm.submit("patient", deadline_ms=60_000.0)
+    pool.open_capacity()
+    adm.close()
+    pool.resolve_all()
+
+
+def test_closed_rejects_new_but_drains_admitted():
+    pool = FakePool(capacity=0)
+    adm, metrics = make_admission(pool)
+    fut = adm.submit("queued-before-close")
+    closer = threading.Thread(target=adm.close)
+    closer.start()
+    time.sleep(0.05)
+    with pytest.raises(Overloaded) as e:
+        adm.submit("late")
+    assert e.value.reason == "closed"
+    assert metrics.shed_count("closed") == 1
+    # the already-admitted request still routes during the drain
+    pool.open_capacity()
+    closer.join(timeout=5)
+    assert not closer.is_alive()
+    pool.resolve_all("drained")
+    assert fut.result(timeout=5) == "drained"
+
+
+def test_lane_error_counts_as_error_outcome():
+    pool = FakePool(capacity=10)
+    adm, metrics = make_admission(pool)
+    fut = adm.submit("x")
+    deadline = time.perf_counter() + 5
+    while not pool.submitted and time.perf_counter() < deadline:
+        time.sleep(0.005)
+    pool.submitted[0][1].set_exception(RuntimeError("lane died"))
+    with pytest.raises(RuntimeError):
+        fut.result(timeout=5)
+    assert metrics.outcome_count("error") == 1
+    adm.close()
+
+
+def test_max_pending_validation():
+    with pytest.raises(ValueError):
+        make_admission(FakePool(), max_pending=0)

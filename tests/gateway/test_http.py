@@ -1,0 +1,190 @@
+"""Gateway HTTP frontend end to end (ephemeral port, CPU backend,
+mirroring tests/observability/test_admin.py): /predict round-trip,
+/metrics scrape with the gateway series, readiness-vs-liveness
+semantics, the forced-swap route, and the admit -> coalesce ->
+dispatch span chain."""
+
+import itertools
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from keystone_tpu.gateway import Gateway, GatewayServer
+from keystone_tpu.observability import (
+    disable_tracing,
+    enable_tracing,
+    get_global_registry,
+    get_tracer,
+)
+
+from gateway_fixtures import D, batch, make_fitted, reference
+
+
+_gw_ids = itertools.count()
+
+
+@pytest.fixture
+def served():
+    """A live gateway + frontend on an ephemeral port. Uses the GLOBAL
+    registry (like production) with a unique gateway name per test so
+    counter assertions never see another test's series."""
+    fitted = make_fitted()
+    gw = Gateway(
+        fitted,
+        buckets=(4, 8),
+        n_lanes=2,
+        max_delay_ms=2.0,
+        warmup_example=np.zeros(D, np.float32),
+        name=f"http-gw{next(_gw_ids)}",
+    )
+    srv = GatewayServer(gw, port=0).start()
+    yield fitted, gw, srv
+    gw.close()
+    srv.stop()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(srv.url(path), timeout=15) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+def _post(srv, path, doc):
+    req = urllib.request.Request(
+        srv.url(path),
+        data=json.dumps(doc).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_predict_round_trip_and_metrics_scrape(served):
+    """Acceptance: POST /predict round-trips through admission ->
+    lanes -> micro-batch -> engine, and GET /metrics shows the gateway
+    series (typed counters + native histograms) alongside the lanes'
+    engine series."""
+    fitted, gw, srv = served
+    xs = batch(4, seed=51)
+    want = reference(fitted, xs)
+    status, doc = _post(srv, "/predict", {"instances": xs.tolist()})
+    assert status == 200
+    np.testing.assert_allclose(
+        np.asarray(doc["predictions"], np.float32), want,
+        rtol=1e-4, atol=1e-5,
+    )
+
+    _, metrics = _get(srv, "/metrics")
+    name = gw.name
+    for line in [
+        f'keystone_gateway_requests_total{{gateway="{name}",status="ok"}} 4',
+        f'keystone_gateway_ready{{gateway="{name}"}} 1',
+        '# TYPE keystone_gateway_request_latency_seconds histogram',
+        f'keystone_gateway_request_latency_seconds_bucket'
+        f'{{gateway="{name}",le="+Inf"}} 4',
+        f'keystone_gateway_request_latency_seconds_count'
+        f'{{gateway="{name}"}} 4',
+        'keystone_gateway_queue_wait_seconds_bucket',
+        # the shared-nothing lanes export per-engine serving series
+        f'keystone_serving_examples_total{{engine="{name}-lane0"}}',
+        f'keystone_serving_examples_total{{engine="{name}-lane1"}}',
+    ]:
+        assert line in metrics, f"missing {line!r} in:\n{metrics}"
+
+
+def test_readyz_is_readiness_not_liveness(served):
+    _, gw, srv = served
+    status, body = _get(srv, "/readyz")
+    assert (status, body) == (200, "ok\n")
+    gw.close()
+    # draining: alive (healthz 200) but NOT ready (readyz 503)
+    status, _ = _get(srv, "/healthz")
+    assert status == 200
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(srv, "/readyz")
+    assert e.value.code == 503
+    assert e.value.read().decode() == "draining\n"
+
+
+def test_predict_after_drain_is_503_typed(served):
+    _, gw, srv = served
+    gw.close()
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(srv, "/predict", {"instances": [batch(1)[0].tolist()]})
+    assert e.value.code == 503
+    doc = json.loads(e.value.read())
+    assert doc["error"] == "overloaded"
+    assert doc["reason"] == "closed"
+
+
+def test_bad_requests_are_400(served):
+    _, _, srv = served
+    for body in [{"instances": []}, {"nope": 1}, {"instances": "x"}]:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv, "/predict", body)
+        assert e.value.code == 400
+
+
+def test_forced_swap_via_http_keeps_serving(served):
+    fitted, gw, srv = served
+    xs = batch(2, seed=52)
+    want = reference(fitted, xs)
+    _post(srv, "/predict", {"instances": xs.tolist()})
+    status, doc = _post(srv, "/swap", {})
+    assert status == 200 and doc["swapped"] is True
+    status, doc = _post(srv, "/predict", {"instances": xs.tolist()})
+    assert status == 200
+    np.testing.assert_allclose(
+        np.asarray(doc["predictions"], np.float32), want,
+        rtol=1e-4, atol=1e-5,
+    )
+    assert gw.metrics.swap_count() == 1
+    _, metrics = _get(srv, "/metrics")
+    assert (
+        f'keystone_gateway_engine_swaps_total{{gateway="{gw.name}"}} 1'
+        in metrics
+    )
+
+
+def test_admit_span_parents_coalesce_dispatch_chain():
+    """The gateway.admit span (client thread) parents the window's
+    microbatch.coalesce span (dispatcher thread), which parents
+    serving.dispatch — the full cross-thread chain in one trace."""
+    tracer = enable_tracing()
+    tracer.clear()
+    try:
+        fitted = make_fitted()
+        with Gateway(
+            fitted, buckets=(4,), n_lanes=1, max_delay_ms=2.0,
+            warmup_example=np.zeros(D, np.float32), name="span-gw",
+        ) as gw:
+            gw.predict(batch(1, seed=53)[0]).result(timeout=30)
+        spans = {s.name: s for s in get_tracer().recent()}
+        admit = spans["gateway.admit"]
+        coalesce = spans["microbatch.coalesce"]
+        dispatch = spans["serving.dispatch"]
+        assert coalesce.parent_id == admit.span_id
+        assert dispatch.parent_id == coalesce.span_id
+        assert admit.attrs["gateway"] == "span-gw"
+    finally:
+        disable_tracing()
+        get_tracer().clear()
+
+
+def test_metrics_route_serves_global_registry(served):
+    _, _, srv = served
+    assert srv.registry is get_global_registry()
+    _, body = _get(srv, "/metrics")
+    assert body.endswith("\n")
+
+
+def test_bad_deadline_ms_is_400(served):
+    _, _, srv = served
+    for bad in ["fast", -5, 0, True]:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv, "/predict", {
+                "instances": [batch(1)[0].tolist()], "deadline_ms": bad,
+            })
+        assert e.value.code == 400, f"deadline_ms={bad!r}"

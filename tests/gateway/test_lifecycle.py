@@ -1,0 +1,191 @@
+"""Gateway lifecycle: the live autoscale/swap loop and graceful drain.
+
+The acceptance test here is swap-under-load: responses straddling a
+live engine swap must be numerically identical to the pre-swap
+engine's, with zero failed requests."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu.gateway import Gateway, Overloaded
+from keystone_tpu.gateway.lifecycle import MIN_REBUCKET_OBSERVATIONS
+from keystone_tpu.observability.registry import MetricsRegistry
+
+from gateway_fixtures import D, batch, reference
+
+
+def make_gateway(fitted, **kw):
+    kw.setdefault("buckets", (4, 8))
+    kw.setdefault("n_lanes", 2)
+    kw.setdefault("max_delay_ms", 2.0)
+    kw.setdefault("warmup_example", np.zeros(D, np.float32))
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("name", "test-gw")
+    return Gateway(fitted, **kw)
+
+
+def test_predict_matches_reference(fitted):
+    with make_gateway(fitted) as gw:
+        xs = batch(10, seed=41)
+        want = reference(fitted, xs)
+        futs = [gw.predict(x) for x in xs]
+        rows = np.stack(
+            [np.asarray(f.result(timeout=30)) for f in futs]
+        )
+    np.testing.assert_allclose(rows, want, rtol=1e-5, atol=1e-6)
+
+
+def test_swap_under_load_zero_failures_identical_outputs(fitted):
+    """Acceptance: under concurrent load, a forced live engine swap
+    completes with zero failed requests, and every response — before,
+    straddling, and after the swap — equals the pre-swap engine's
+    output for the same input."""
+    n_clients, per_client = 4, 40
+    xs = batch(16, seed=42)
+    want = reference(fitted, xs)  # the pre-swap engine's outputs
+    with make_gateway(fitted) as gw:
+        failures = []
+        mismatches = []
+        started = threading.Barrier(n_clients + 1)
+
+        def client(tid):
+            rng = np.random.default_rng(tid)
+            started.wait()
+            for _ in range(per_client):
+                i = int(rng.integers(0, len(xs)))
+                try:
+                    out = np.asarray(
+                        gw.predict(xs[i]).result(timeout=30)
+                    )
+                except Exception as e:  # pragma: no cover - must not
+                    failures.append(e)
+                    continue
+                if not np.allclose(
+                    out, want[i], rtol=1e-5, atol=1e-6
+                ):
+                    mismatches.append(i)
+
+        threads = [
+            threading.Thread(target=client, args=(t,))
+            for t in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        started.wait()
+        time.sleep(0.02)  # clients in flight
+        gw.swap_engines((2, 8))  # build + warm + atomic swap, mid-load
+        for t in threads:
+            t.join()
+        assert not failures, failures[:3]
+        assert not mismatches, (
+            f"{len(mismatches)} responses diverged across the swap"
+        )
+        assert gw.metrics.swap_count() == 1
+        assert gw.buckets == (2, 8)
+        assert all(
+            lane.engine.buckets == (2, 8) for lane in gw.pool.lanes
+        )
+
+
+def test_rebucket_needs_evidence_unless_forced(fitted):
+    with make_gateway(fitted, buckets=(4, 8)) as gw:
+        # no traffic at all: even force falls back to the same buckets
+        # but still swaps (the drill semantics)
+        assert gw.rebucket() is False
+        assert gw.metrics.swap_count() == 0
+        for x in batch(3, seed=43):
+            gw.predict(x).result(timeout=30)
+        # a handful of observations is not evidence
+        assert sum(gw.observed_sizes().values()) > 0
+        assert gw.rebucket() is False
+        assert gw.rebucket(force=True) is True
+        assert gw.metrics.swap_count() == 1
+
+
+def test_rebucket_acts_on_observed_traffic(fitted):
+    with make_gateway(
+        fitted, buckets=(8,), rebucket_k=2, max_delay_ms=0.5
+    ) as gw:
+        # all-singleton traffic: the padding-minimal 2-bucket set over
+        # sizes {1..} must include a small bucket
+        sent = 0
+        while sent < MIN_REBUCKET_OBSERVATIONS:
+            gw.predict(batch(1, seed=sent)[0]).result(timeout=30)
+            sent += 1
+        assert gw.rebucket() is True
+        assert gw.buckets[-1] == 8  # forced max bucket survives
+        assert gw.buckets[0] < 8  # and a tighter bucket appeared
+        # idempotent: the proposal now matches the active set
+        assert gw.rebucket() is False
+
+
+def test_maintenance_loop_rebuckets_in_background(fitted):
+    with make_gateway(
+        fitted, buckets=(8,), rebucket_k=2, max_delay_ms=0.5,
+        maintenance_interval_s=0.2,
+    ) as gw:
+        for i in range(MIN_REBUCKET_OBSERVATIONS + 8):
+            gw.predict(batch(1, seed=i)[0]).result(timeout=30)
+        deadline = time.perf_counter() + 10
+        while (
+            gw.metrics.swap_count() == 0
+            and time.perf_counter() < deadline
+        ):
+            time.sleep(0.05)
+        assert gw.metrics.swap_count() >= 1
+        assert gw.buckets[0] < 8
+
+
+def test_graceful_close_flips_ready_then_drains(fitted):
+    gw = make_gateway(fitted)
+    assert gw.ready
+    fut = gw.predict(batch(1, seed=44)[0])
+    gw.close()
+    assert not gw.ready
+    # the admitted request resolved during the drain
+    assert np.asarray(fut.result(timeout=5)).shape == (3,)
+    with pytest.raises(Overloaded) as e:
+        gw.predict(batch(1)[0])
+    assert e.value.reason == "closed"
+    gw.close()  # idempotent
+
+
+def test_ready_gauge_tracks_lifecycle(fitted):
+    reg = MetricsRegistry()
+    gw = make_gateway(fitted, registry=reg, name="gauge-gw")
+    g = reg.gauge("keystone_gateway_ready", labelnames=("gateway",))
+    assert g.get(("gauge-gw",)) == 1.0
+    gw.close()
+    assert g.get(("gauge-gw",)) == 0.0
+
+
+def test_beyond_capacity_traffic_sheds_typed_admitted_resolves(fitted):
+    """Overload semantics: flooding past the queue bound sheds the
+    excess IMMEDIATELY with typed Overloaded(queue_full) errors, while
+    every admitted request still resolves correctly."""
+    with make_gateway(
+        fitted, n_lanes=1, max_pending=8, lane_capacity=2,
+        max_delay_ms=20.0, name="shed-gw",
+    ) as gw:
+        xs = batch(8, seed=45)
+        want = reference(fitted, xs)
+        admitted, shed = [], []
+        for i in range(120):
+            j = i % len(xs)
+            try:
+                admitted.append((j, gw.predict(xs[j])))
+            except Overloaded as e:
+                assert e.reason == "queue_full"
+                shed.append(e)
+        assert shed, "flood never hit the queue bound"
+        assert len(admitted) >= 8
+        for j, fut in admitted:
+            np.testing.assert_allclose(
+                np.asarray(fut.result(timeout=30)), want[j],
+                rtol=1e-5, atol=1e-6,
+            )
+        assert gw.metrics.shed_count("queue_full") == len(shed)
+        assert gw.metrics.outcome_count("ok") == len(admitted)

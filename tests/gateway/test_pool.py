@@ -1,0 +1,218 @@
+"""EnginePool: least-loaded routing across shared-nothing lanes,
+retry-to-another-lane on lane failure, per-lane health benching, and
+the atomic build-then-swap contract."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.gateway.metrics import GatewayMetrics
+from keystone_tpu.gateway.pool import UNHEALTHY_AFTER, EnginePool
+from keystone_tpu.observability.registry import MetricsRegistry
+from keystone_tpu.serving.metrics import ServingMetrics
+
+from gateway_fixtures import D, batch, reference
+
+
+def make_pool(fitted, n_lanes=2, buckets=(4,), **kw):
+    metrics = GatewayMetrics(
+        registry=MetricsRegistry(), gateway="pool-test"
+    )
+    pool = EnginePool(
+        lambda name: fitted.compiled(buckets=buckets, name=name),
+        n_lanes,
+        name="pool-test",
+        max_delay_ms=2.0,
+        metrics=metrics,
+        **kw,
+    )
+    return pool, metrics
+
+
+class BrokenEngine:
+    """Duck-typed engine whose every dispatch fails — a dead lane."""
+
+    def __init__(self, name="broken"):
+        self.name = name
+        self.max_bucket = 4
+        self.buckets = (4,)
+        self.metrics = ServingMetrics()
+
+    def apply(self, data, sync=False, owned=False):
+        raise RuntimeError("lane hardware gone")
+
+
+def test_requests_fan_across_lanes_and_resolve(fitted):
+    pool, _ = make_pool(fitted, n_lanes=2)
+    xs = batch(16, seed=31)
+    want = reference(fitted, xs)
+    with pool:
+        futs = [pool.submit(x) for x in xs]
+        rows = np.stack(
+            [np.asarray(f.result(timeout=30)) for f in futs]
+        )
+    np.testing.assert_allclose(rows, want, rtol=1e-5, atol=1e-6)
+    served = [l.engine.metrics.examples.total for l in pool.lanes]
+    assert sum(served) == 16
+    assert all(s > 0 for s in served)  # least-loaded used BOTH lanes
+
+
+def test_lane_failure_retries_on_another_lane(fitted):
+    pool, metrics = make_pool(fitted, n_lanes=2)
+    with pool:
+        pool.lanes[0].batcher.swap_engine(BrokenEngine())
+        xs = batch(12, seed=32)
+        want = reference(fitted, xs)
+        futs = [pool.submit(x) for x in xs]
+        rows = np.stack(
+            [np.asarray(f.result(timeout=30)) for f in futs]
+        )
+        # every request resolved correctly despite a dead lane...
+        np.testing.assert_allclose(rows, want, rtol=1e-5, atol=1e-6)
+        # ...because failures retried onto the healthy lane
+        assert metrics.retry_count() >= 1
+        assert pool.lanes[1].engine.metrics.examples.total == 12
+        # and the dead lane got benched after consecutive failures
+        assert not pool.lanes[0].healthy
+        assert pool.healthy_lanes() == 1
+
+
+def test_health_restores_on_success(fitted):
+    pool, _ = make_pool(fitted, n_lanes=2)
+    with pool:
+        lane = pool.lanes[0]
+        for _ in range(UNHEALTHY_AFTER):
+            lane.mark_failed()
+        assert not lane.healthy
+        lane.mark_ok()
+        assert lane.healthy
+
+
+def test_request_caused_errors_never_bench_lanes(fitted):
+    """A deterministically-bad request (fails on every lane it touches)
+    charges NO lane's health — malformed client traffic can't starve
+    well-formed requests by benching the pool."""
+    pool, _ = make_pool(fitted, n_lanes=2)
+    with pool:
+        bad = np.zeros(D + 3, np.float32)  # wrong feature dim
+        for _ in range(UNHEALTHY_AFTER + 2):
+            with pytest.raises(Exception):
+                pool.submit(bad).result(timeout=30)
+        assert pool.healthy_lanes() == 2  # nobody benched
+        # and good traffic still flows at full capacity
+        out = pool.submit(batch(1, seed=37)[0]).result(timeout=30)
+        assert np.asarray(out).shape == (3,)
+
+
+def test_swap_is_atomic_on_factory_failure(fitted):
+    pool, metrics = make_pool(fitted, n_lanes=2)
+    with pool:
+        old_engines = [l.engine for l in pool.lanes]
+
+        calls = []
+
+        def bad_factory(name):
+            calls.append(name)
+            if len(calls) == 2:  # second lane's build explodes
+                raise RuntimeError("OOM compiling replacement")
+            return fitted.compiled(buckets=(2, 4), name=name)
+
+        with pytest.raises(RuntimeError):
+            pool.swap(bad_factory)
+        # the failed swap touched NOTHING: old engines still serving
+        assert [l.engine for l in pool.lanes] == old_engines
+        assert metrics.swap_count() == 0
+        out = pool.submit(batch(1, seed=33)[0]).result(timeout=30)
+        assert np.asarray(out).shape == (3,)
+
+
+def test_swap_replaces_every_lane_and_counts(fitted):
+    pool, metrics = make_pool(fitted, n_lanes=2)
+    with pool:
+        xs = batch(6, seed=34)
+        want = reference(fitted, xs)
+        for x in xs[:3]:
+            pool.submit(x).result(timeout=30)
+        old = pool.swap(
+            lambda name: fitted.compiled(buckets=(2, 8), name=name),
+            warmup_example=np.zeros(D, np.float32),
+        )
+        assert len(old) == 2
+        assert all(l.engine.buckets == (2, 8) for l in pool.lanes)
+        assert metrics.swap_count() == 1
+        rows = np.stack(
+            [
+                np.asarray(pool.submit(x).result(timeout=30))
+                for x in xs[3:]
+            ]
+        )
+        np.testing.assert_allclose(rows, want[3:], rtol=1e-5, atol=1e-6)
+
+
+def test_closed_pool_rejects(fitted):
+    pool, _ = make_pool(fitted, n_lanes=1)
+    pool.close()
+    with pytest.raises(RuntimeError):
+        pool.submit(batch(1)[0])
+    with pytest.raises(RuntimeError):
+        pool.swap()
+
+
+def test_lane_capacity_and_free_accounting(fitted):
+    pool, _ = make_pool(fitted, n_lanes=2, lane_capacity=3)
+    with pool:
+        assert pool.free_capacity() == 6
+        assert pool.total_load() == 0
+        futs = [pool.submit(x) for x in batch(4, seed=35)]
+        for f in futs:
+            f.result(timeout=30)
+        assert pool.total_load() == 0  # all resolved -> load drained
+
+
+def test_retry_is_bounded_not_a_lane_tour(fitted):
+    """A deterministically-bad request executes on at most
+    1 + max_retries lanes (default: two), not every lane in the pool."""
+    attempts = []
+
+    class CountingBrokenEngine(BrokenEngine):
+        def apply(self, data, sync=False, owned=False):
+            attempts.append(self.name)
+            raise RuntimeError("always fails")
+
+    pool, metrics = make_pool(fitted, n_lanes=4)
+    with pool:
+        for lane in pool.lanes:
+            lane.batcher.swap_engine(
+                CountingBrokenEngine(f"broken{lane.index}")
+            )
+        fut = pool.submit(batch(1, seed=36)[0])
+        with pytest.raises(RuntimeError):
+            fut.result(timeout=30)
+        assert len(attempts) == 2  # first attempt + exactly one retry
+        assert metrics.retry_count() == 1
+
+
+def test_lane_capacity_follows_engine_swap(fitted):
+    """An unpinned lane's capacity tracks the CURRENT engine's window
+    size — a rebucket to larger buckets widens the lane instead of
+    throttling at the old bucket's scale."""
+    pool, _ = make_pool(fitted, n_lanes=1, buckets=(4,))
+    with pool:
+        assert pool.lanes[0].capacity == 8  # 2 windows of 4
+        pool.swap(lambda name: fitted.compiled(buckets=(16,), name=name))
+        assert pool.lanes[0].capacity == 32  # follows the new bucket
+
+
+def test_submit_time_raise_never_benches_and_retries(fitted):
+    """An example whose spec can't even be computed (ragged pytree)
+    raises at lane-submit time; it must retry like a dispatch failure
+    and charge no lane's health."""
+    pool, metrics = make_pool(fitted, n_lanes=2)
+    with pool:
+        ragged = [[1.0, 2.0], [3.0]]  # np.asarray raises at spec time
+        for _ in range(UNHEALTHY_AFTER + 1):
+            with pytest.raises(Exception):
+                pool.submit(ragged).result(timeout=30)
+        assert pool.healthy_lanes() == 2
+        assert metrics.retry_count() >= 1  # the retry path engaged
+        out = pool.submit(batch(1, seed=38)[0]).result(timeout=30)
+        assert np.asarray(out).shape == (3,)

@@ -138,3 +138,38 @@ def test_invalid_name_sanitized_in_render():
     out = render_family(fam)
     assert "bad_name_here" in out
     assert 'l_x="v"' in out
+
+
+def test_format_le():
+    from keystone_tpu.observability.prometheus import format_le
+
+    assert format_le(0.005) == "0.005"
+    assert format_le(1.0) == "1"
+    assert format_le(2.5) == "2.5"
+    assert format_le(float("inf")) == "+Inf"
+
+
+def test_histogram_family_golden():
+    """A RegistryHistogram renders as native Prometheus histogram
+    exposition: cumulative _bucket lines with le labels, then _count
+    and _sum — the promtool-parseable shape histogram_quantile eats."""
+    from keystone_tpu.observability.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    h = reg.histogram(
+        "gw_wait_seconds", "queue wait", ("gateway",),
+        buckets=(0.01, 0.25, 1.0),
+    )
+    h.observe(0.005, ("g",))
+    h.observe(0.2, ("g",))
+    h.observe(3.0, ("g",))
+    assert render_family(h.collect()) == (
+        "# HELP gw_wait_seconds queue wait\n"
+        "# TYPE gw_wait_seconds histogram\n"
+        'gw_wait_seconds_bucket{gateway="g",le="0.01"} 1\n'
+        'gw_wait_seconds_bucket{gateway="g",le="0.25"} 2\n'
+        'gw_wait_seconds_bucket{gateway="g",le="1"} 2\n'
+        'gw_wait_seconds_bucket{gateway="g",le="+Inf"} 3\n'
+        'gw_wait_seconds_count{gateway="g"} 3\n'
+        'gw_wait_seconds_sum{gateway="g"} 3.205\n'
+    )

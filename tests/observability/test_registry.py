@@ -166,3 +166,75 @@ def test_global_registry_is_singleton_and_threadsafe():
     for t in threads:
         t.join()
     assert c.get() == 8000
+
+
+def test_histogram_buckets_cumulative_sum_count():
+    reg = MetricsRegistry()
+    h = reg.histogram(
+        "lat_seconds", "latency", labelnames=("gw",),
+        buckets=(0.01, 0.1, 1.0),
+    )
+    for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+        h.observe(v, ("g",))
+    assert h.get_count(("g",)) == 5
+    fam = h.collect()
+    assert fam.mtype == "histogram"
+    by_le = {
+        s.labels["le"]: s.value
+        for s in fam.samples
+        if s.suffix == "_bucket"
+    }
+    # cumulative: le buckets ADD (the aggregability summaries lack)
+    assert by_le == {"0.01": 1, "0.1": 3, "1": 4, "+Inf": 5}
+    count = [s for s in fam.samples if s.suffix == "_count"][0]
+    total = [s for s in fam.samples if s.suffix == "_sum"][0]
+    assert count.value == 5
+    assert total.value == pytest.approx(5.605)
+
+
+def test_histogram_le_boundary_is_inclusive():
+    reg = MetricsRegistry()
+    h = reg.histogram("h_seconds", buckets=(1.0, 2.0))
+    h.observe(1.0)  # le="1" must include exactly-1.0 (Prometheus <=)
+    by_le = {
+        s.labels["le"]: s.value
+        for s in h.collect().samples
+        if s.suffix == "_bucket"
+    }
+    assert by_le["1"] == 1
+
+
+def test_histogram_validation_and_reregistration():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.histogram("bad", buckets=())
+    with pytest.raises(ValueError):
+        reg.histogram("bad2", buckets=(2.0, 1.0))
+    h = reg.histogram("ok_seconds", buckets=(1.0, 2.0))
+    assert reg.histogram("ok_seconds", buckets=(1.0, 2.0)) is h
+    with pytest.raises(ValueError):
+        reg.histogram("ok_seconds", buckets=(1.0, 3.0))
+    with pytest.raises(ValueError):
+        reg.counter("ok_seconds")
+
+
+def test_histogram_concurrent_observes():
+    reg = MetricsRegistry()
+    h = reg.histogram("conc_seconds", buckets=(0.5,))
+
+    def worker():
+        for _ in range(500):
+            h.observe(0.25)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.get_count() == 2000
+
+
+def test_histogram_rejects_explicit_inf_bound():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.histogram("inf_seconds", buckets=(1.0, float("inf")))

@@ -101,22 +101,77 @@ def test_max_batch_validation(fitted):
         MicroBatcher(engine, max_batch=8)
 
 
-def test_mismatched_example_rejected_at_submit(fitted):
-    """A ragged request fails ITSELF at submit(); co-batched requests
-    still resolve. The deadline is far longer than the test body so the
-    window deterministically stays open across both submits (the
-    mismatch check is per-window: in a drained window the same request
-    would instead open its own window and fail at dispatch)."""
-    engine = CompiledPipeline(fitted, buckets=(4,))
+def test_mixed_shape_streams_coalesce_separately(fitted):
+    """Two interleaved well-formed request streams with different specs
+    (single example vs a [2, D] pair treated as one example of a
+    2-example pipeline input... here: different dtypes) each coalesce
+    into their own spec-homogeneous windows — neither stream errors,
+    every future resolves with its own correct row, and no dispatched
+    window ever mixes specs (the stack() would raise if one did)."""
+    engine = CompiledPipeline(fitted, buckets=(4, 16))
     engine.warmup(example=jnp.zeros((D,), jnp.float32))
-    good_x = batch(1, seed=1)[0]
-    bad_x = np.zeros(D + 1, np.float32)
-    with MicroBatcher(engine, max_delay_ms=10_000.0, max_batch=4) as mb:
-        good = mb.submit(good_x)
-        with pytest.raises(ValueError):
-            mb.submit(bad_x)  # wrong feature dim, same open window
-        # close() flushes the window well before the deadline
-    assert np.asarray(good.result(timeout=30)).shape == (3,)
+    n = 8
+    xs32 = batch(n, seed=11)
+    xs64 = batch(n, seed=12).astype(np.float64)
+    want32 = np.asarray(
+        fitted.apply(Dataset.from_array(jnp.asarray(xs32))).array()
+    )
+    futures = {}
+    with MicroBatcher(engine, max_delay_ms=100.0) as mb:
+        for i in range(n):  # strictly interleaved submission order
+            futures[("f32", i)] = mb.submit(xs32[i])
+            futures[("f64", i)] = mb.submit(xs64[i])
+        rows = {
+            k: np.asarray(f.result(timeout=30))
+            for k, f in futures.items()
+        }
+    for i in range(n):
+        np.testing.assert_allclose(
+            rows[("f32", i)], want32[i], rtol=1e-5, atol=1e-6
+        )
+        # f64 input downcasts on the jnp.stack to the engine's f32
+        # path; correctness vs the f32 reference of the same values
+        want_i = np.asarray(
+            fitted.apply(
+                Dataset.from_array(jnp.asarray(xs64[i:i + 1], jnp.float32))
+            ).array()
+        )[0]
+        np.testing.assert_allclose(
+            rows[("f64", i)], want_i, rtol=1e-4, atol=1e-5
+        )
+    # both streams still coalesced (not 2n solo dispatches)
+    assert engine.metrics.max_coalesced >= 2
+    assert engine.metrics.request_latency.count == 2 * n
+
+
+def test_swap_engine_mid_stream(fitted):
+    """The live re-bucket hook: swapping the engine behind the batcher
+    mid-stream loses no requests, later windows dispatch through the
+    replacement (its metrics see them), and results are identical to
+    the pre-swap engine's."""
+    old = CompiledPipeline(fitted, buckets=(4,), name="swap-old")
+    old.warmup(example=jnp.zeros((D,), jnp.float32))
+    new = CompiledPipeline(fitted, buckets=(2, 8), name="swap-new")
+    new.warmup(example=jnp.zeros((D,), jnp.float32))
+    xs = batch(8, seed=21)
+    want = np.asarray(
+        fitted.apply(Dataset.from_array(jnp.asarray(xs))).array()
+    )
+    with MicroBatcher(old, max_delay_ms=5.0) as mb:
+        first = [mb.submit(x) for x in xs[:4]]
+        for f in first:
+            f.result(timeout=30)
+        returned = mb.swap_engine(new)
+        assert returned is old
+        assert mb.max_batch == new.max_bucket  # default follows the swap
+        second = [mb.submit(x) for x in xs[4:]]
+        rows = [
+            np.asarray(f.result(timeout=30)) for f in first + second
+        ]
+    np.testing.assert_allclose(np.stack(rows), want, rtol=1e-5, atol=1e-6)
+    # post-swap traffic ran on the replacement engine
+    assert new.metrics.examples.total == 4
+    assert old.metrics.examples.total >= 4
 
 
 def test_error_propagates_to_futures(fitted):
