@@ -80,4 +80,21 @@ echo "PASS /metrics ($(grep -c '^keystone_' <<<"$METRICS") keystone series)"
 fetch "$BASE/tracez" | grep -q '"serving.dispatch"' || {
     echo "FAIL: /tracez has no serving.dispatch span"; exit 1; }
 echo "PASS /tracez"
+
+# /slz renders even with no SLOs declared (empty objective list), and
+# /varz carries the build/uptime identity block
+fetch "$BASE/slz" | grep -q '"slos"' || {
+    echo "FAIL: /slz did not render"; exit 1; }
+echo "PASS /slz"
+VARZ="$(fetch "$BASE/varz")"
+for want in '"build"' '"git_sha"' '"uptime_s"' '"jax_version"'; do
+    grep -q "$want" <<<"$VARZ" || {
+        echo "FAIL: /varz missing $want"; exit 1; }
+done
+fetch "$BASE/metrics" | grep -q '^keystone_build_info{' || {
+    echo "FAIL: /metrics missing keystone_build_info"; exit 1; }
+echo "PASS /varz build info"
+fetch "$BASE/debugz" | grep -q '"records"' || {
+    echo "FAIL: /debugz did not render"; exit 1; }
+echo "PASS /debugz"
 echo "smoke-admin: all checks passed"

@@ -21,17 +21,23 @@ cleanup() {
 trap cleanup EXIT
 
 # the gateway demo entry on port 0 (ephemeral); patched to report the
-# bound port to $PORT_FILE via a tiny wrapper
+# bound port to $PORT_FILE via a tiny wrapper. A deliberately
+# unmeetable latency SLO (0.1 ms) makes every request an injected-slow
+# request: burn gauges light up, the flight recorder captures span
+# trees, and the latency histogram carries trace_id exemplars.
 JAX_PLATFORMS=cpu PYTHONPATH="$ROOT" python - "$PORT_FILE" >"$SERVER_LOG" 2>&1 <<'PY' &
 import sys, time
 import jax.numpy as jnp
 from keystone_tpu.gateway import Gateway, GatewayServer
+from keystone_tpu.observability import enable_tracing
 from keystone_tpu.serving.bench import build_pipeline
 
+enable_tracing()
 fitted = build_pipeline(d=8, hidden=8, depth=2)
 gateway = Gateway(
     fitted, buckets=(4, 8), n_lanes=2,
     warmup_example=jnp.zeros((8,), jnp.float32), name="smoke",
+    slo_latency_s=0.0001, slo_sample_interval_s=0.5,
 )
 server = GatewayServer(gateway, port=0).start()
 with open(sys.argv[1], "w") as f:
@@ -57,6 +63,18 @@ fetch() {  # fetch <url> — curl when present, stdlib urllib otherwise
     else
         python -c 'import sys, urllib.request; \
 sys.stdout.write(urllib.request.urlopen(sys.argv[1], timeout=15).read().decode())' "$1"
+    fi
+}
+
+fetch_om() {  # fetch with the OpenMetrics Accept header (exemplars)
+    if command -v curl >/dev/null 2>&1; then
+        curl -fsS --max-time 15 \
+            -H 'Accept: application/openmetrics-text' "$1"
+    else
+        python -c 'import sys, urllib.request; \
+req = urllib.request.Request(sys.argv[1], \
+headers={"Accept": "application/openmetrics-text"}); \
+sys.stdout.write(urllib.request.urlopen(req, timeout=15).read().decode())' "$1"
     fi
 }
 
@@ -102,6 +120,29 @@ do
         echo "FAIL: /metrics missing: $want"; echo "$METRICS"; exit 1; }
 done
 echo "PASS /metrics ($(grep -c '^keystone_gateway' <<<"$METRICS") gateway lines)"
+
+# forensic chain: the SLO objectives render at /slz with burn rates,
+# the injected-slow requests are tail-sampled at /debugz with their
+# span trees, and the latency histogram links to them via exemplars
+fetch "$BASE/slz" | grep -q '"smoke:latency"' || {
+    echo "FAIL: /slz missing the smoke:latency SLO"; exit 1; }
+echo "PASS /slz"
+DEBUGZ="$(fetch "$BASE/debugz")"
+grep -q '"slo_breach"' <<<"$DEBUGZ" || {
+    echo "FAIL: /debugz has no slo_breach record"; echo "$DEBUGZ"; exit 1; }
+grep -q '"gateway.admit"' <<<"$DEBUGZ" || {
+    echo "FAIL: /debugz record is missing its span tree"; exit 1; }
+echo "PASS /debugz (injected-slow request captured with span tree)"
+# exemplars only travel in the OpenMetrics rendering (the classic
+# v0.0.4 parser would reject the mid-line '#'), so scrape with the
+# Accept header a real Prometheus server sends; the plain scrape above
+# must stay exemplar-free
+OM_METRICS="$(fetch_om "$BASE/metrics")"
+grep -q '# {trace_id="' <<<"$OM_METRICS" || {
+    echo "FAIL: openmetrics /metrics has no trace_id exemplar"; exit 1; }
+grep -q '# {trace_id="' <<<"$METRICS" && {
+    echo "FAIL: classic /metrics scrape carries exemplar tails"; exit 1; }
+echo "PASS exemplars (openmetrics only)"
 
 SWAP="$(post "$BASE/swap" '{}')"
 grep -q '"swapped": *true' <<<"$SWAP" || {

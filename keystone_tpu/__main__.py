@@ -44,6 +44,30 @@ def main(argv=None) -> int:
         server = start_admin_server(port=port)
         print(f"admin endpoint: {server.url()} "
               "(/metrics /varz /healthz /tracez)", flush=True)
+    if "--otlp-endpoint" in argv:
+        # OTLP/HTTP span export: every finished span batches to a
+        # collector's /v1/traces on a background thread (stdlib urllib,
+        # nothing to install). Peeled before app dispatch like
+        # --admin-port; implies tracing on.
+        i = argv.index("--otlp-endpoint")
+        try:
+            endpoint = argv[i + 1]
+            if endpoint.startswith("-"):
+                raise ValueError(endpoint)
+        except (IndexError, ValueError):
+            print("--otlp-endpoint requires a collector URL "
+                  "(e.g. http://127.0.0.1:4318)")
+            return 2
+        del argv[i : i + 2]
+        from keystone_tpu.observability import (
+            OtlpSpanExporter,
+            enable_tracing,
+        )
+
+        enable_tracing()
+        exporter = OtlpSpanExporter(endpoint)
+        exporter.install()
+        print(f"otlp export: {exporter.endpoint}", flush=True)
     gateway_port = None
     if "--gateway-port" in argv:
         # request plane: admission control + replica lanes + live
@@ -80,7 +104,8 @@ def main(argv=None) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print(
             "usage: python -m keystone_tpu [--debug-optimizer] "
-            "[--admin-port N] [--gateway-port N] <AppName> [app args...]"
+            "[--admin-port N] [--gateway-port N] [--otlp-endpoint URL] "
+            "<AppName> [app args...]"
         )
         print("apps:")
         for name in sorted(APPS):
@@ -101,12 +126,19 @@ def main(argv=None) -> int:
               " /metrics (Prometheus")
         print("                   text exposition of every live engine's"
               " compile/dispatch/latency")
-        print("                   counters), /varz (JSON), /healthz,"
-              " /tracez (recent spans; add")
-        print("                   ?format=chrome for a Perfetto/"
-              "chrome://tracing trace). N=0 picks")
+        print("                   counters), /varz (JSON + build info),"
+              " /healthz, /tracez (recent")
+        print("                   spans; add ?format=chrome for a"
+              " Perfetto/chrome://tracing trace),")
+        print("                   /slz (SLO burn rates), /debugz (flight"
+              " recorder). N=0 picks")
         print("                   an ephemeral port. Off by default —"
               " zero overhead when absent.")
+        print("  --otlp-endpoint URL  export spans to an OTLP/HTTP"
+              " collector (POST")
+        print("                   URL/v1/traces, background batching,"
+              " stdlib-only). Implies")
+        print("                   tracing on. Off by default.")
         return 0 if argv else 2
     app = argv[0]
     if app == "serve-bench":

@@ -15,14 +15,21 @@ Every request passes one policy gate BEFORE it can touch an engine:
 - **deadline propagation** — the deadline travels with the request: if
   it expires while queued (load arrived after admission), the router
   sheds it at hand-off time instead of wasting engine cycles on an
-  answer nobody is waiting for.
+  answer nobody is waiting for;
+- **SLO pressure** — the gateway's burn-rate watchdog can *tighten*
+  admission (``set_pressure``): while the fast-window burn says the
+  latency budget is being torched, the effective queue bound shrinks
+  and arrivals beyond it shed with reason ``slo_pressure`` — shedding
+  *early*, before the queue saturates, is what arrests the burn.
 
 Instrumented via ``GatewayMetrics``: ``keystone_gateway_shed_total``
 by reason, queue-depth/inflight gauges, and the queue-wait native
-histogram. Each admission opens a ``gateway.admit`` span whose id rides
-with the request so the micro-batcher's ``microbatch.coalesce`` span —
-on another thread — parents under it, completing the
-admit → coalesce → dispatch chain in ``/tracez``.
+histogram. Each admission opens a ``gateway.admit`` span whose id and
+trace id ride with the request so the micro-batcher's
+``microbatch.coalesce`` span — on another thread — parents under it,
+completing the admit → coalesce → dispatch chain in ``/tracez``; the
+trace id also lands on the latency histogram as an OpenMetrics
+exemplar and keys the flight recorder's tail-sampled forensics.
 """
 
 from __future__ import annotations
@@ -36,6 +43,7 @@ from concurrent.futures import Future
 from typing import Any, Deque, Optional
 
 from keystone_tpu.gateway.metrics import GatewayMetrics
+from keystone_tpu.observability.flight import FlightRecorder
 from keystone_tpu.observability.tracing import get_tracer
 
 logger = logging.getLogger(__name__)
@@ -49,10 +57,12 @@ MIN_RATE_SAMPLES = 8
 class Overloaded(RuntimeError):
     """Typed shed/reject error. ``reason`` is one of:
 
-    - ``queue_full`` — the bounded admission queue is at capacity;
-    - ``deadline``   — estimated wait exceeds the request's deadline;
-    - ``expired``    — the deadline passed while the request was queued;
-    - ``closed``     — the gateway is draining and admits nothing.
+    - ``queue_full``   — the bounded admission queue is at capacity;
+    - ``slo_pressure`` — the SLO burn watchdog tightened admission and
+      the queue is past the TIGHTENED bound (early shed);
+    - ``deadline``     — estimated wait exceeds the request's deadline;
+    - ``expired``      — the deadline passed while the request queued;
+    - ``closed``       — the gateway is draining and admits nothing.
 
     HTTP maps these to 429 (shed), 504 (expired), 503 (closed)."""
 
@@ -94,6 +104,7 @@ class _Request:
     t_admit: float
     deadline_t: Optional[float]  # absolute perf_counter deadline
     parent_span_id: Optional[int]
+    trace_id: Optional[str] = None
 
 
 class AdmissionController:
@@ -108,6 +119,8 @@ class AdmissionController:
         default_deadline_ms: Optional[float] = None,
         metrics: Optional[GatewayMetrics] = None,
         name: str = "gateway",
+        flight: Optional[FlightRecorder] = None,
+        forensic_threshold_s: Optional[float] = None,
     ):
         if max_pending < 1:
             raise ValueError(f"max_pending must be >= 1, got {max_pending}")
@@ -115,6 +128,13 @@ class AdmissionController:
         self.name = name
         self.max_pending = max_pending
         self.default_deadline_ms = default_deadline_ms
+        # SLO-watchdog admission tightening: pressure in [0, 1] shrinks
+        # the effective queue bound (0 = none; see set_pressure)
+        self._pressure = 0.0
+        # tail-sampling forensics: when wired, every finished request's
+        # verdict goes through the flight recorder's capture decision
+        self.flight = flight
+        self.forensic_threshold_s = forensic_threshold_s
         self.metrics = metrics if metrics is not None else GatewayMetrics(
             gateway=name
         )
@@ -141,6 +161,23 @@ class AdmissionController:
     def queue_depth(self) -> int:
         with self._cond:
             return len(self._queue)
+
+    @property
+    def pressure(self) -> float:
+        return self._pressure
+
+    def set_pressure(self, pressure: float) -> None:
+        """SLO-watchdog hook: ``pressure`` in [0, 1] shrinks the
+        effective queue bound to ``max_pending * (1 - pressure)`` so
+        the gateway sheds *before* the queue saturates while the error
+        budget is burning. 0 restores normal admission."""
+        self._pressure = min(1.0, max(0.0, float(pressure)))
+
+    @property
+    def effective_max_pending(self) -> int:
+        if self._pressure <= 0.0:
+            return self.max_pending
+        return max(1, int(self.max_pending * (1.0 - self._pressure)))
 
     def estimated_wait_s(self) -> Optional[float]:
         """Pending work (queued + in-lane) over the measured completion
@@ -180,6 +217,12 @@ class AdmissionController:
                 if depth >= self.max_pending:
                     self.metrics.record_shed("queue_full")
                     raise Overloaded("queue_full", queue_depth=depth)
+                if depth >= self.effective_max_pending:
+                    # the SLO watchdog tightened admission: the queue
+                    # is not FULL, but filling it further while the
+                    # latency budget burns only deepens the breach
+                    self.metrics.record_shed("slo_pressure")
+                    raise Overloaded("slo_pressure", queue_depth=depth)
                 if deadline_s is not None:
                     est = self.estimated_wait_s()
                     if est is not None and est > deadline_s:
@@ -199,7 +242,11 @@ class AdmissionController:
                         t + deadline_s if deadline_s is not None else None
                     ),
                     parent_span_id=span.span_id,
+                    trace_id=getattr(span, "trace_id", None),
                 )
+                # ride the identity on the future so the HTTP frontend
+                # can log a greppable trace_id per request
+                req.future.trace_id = req.trace_id
                 self._queue.append(req)
                 self.metrics.set_queue_depth(len(self._queue))
                 self._cond.notify()
@@ -262,7 +309,17 @@ class AdmissionController:
         with self._comp_lock:
             self._completions.append(now)
         self.metrics.set_inflight(self.pool.total_load())
-        self.metrics.record_latency(now - req.t_admit)
+        latency_s = now - req.t_admit
+        # the trace id rides onto the histogram as an exemplar: the
+        # bucket this latency lands in links straight back to the
+        # request's span tree (flight recorder / /debugz)
+        self.metrics.record_latency(latency_s, trace_id=req.trace_id)
+        lane_index = getattr(lane_fut, "lane_index", None)
+        req.future.lane_index = lane_index
+        # the measured per-request latency rides with lane/trace id so
+        # the HTTP request log reports THIS request's number, not the
+        # wait on whichever sibling future was iterated first
+        req.future.latency_s = latency_s
         err = lane_fut.exception()
         if err is None:
             self.metrics.record_outcome("ok")
@@ -271,6 +328,17 @@ class AdmissionController:
         else:
             self.metrics.record_outcome("error")
             _fail(req.future, err)
+        if self.flight is not None:
+            # tail-sampling verdict: only over-threshold or errored
+            # requests pin their span tree into the forensic ring
+            self.flight.maybe_capture(
+                req.trace_id,
+                duration_s=latency_s,
+                error=err,
+                threshold_s=self.forensic_threshold_s,
+                gateway=self.name,
+                lane=lane_index,
+            )
 
     # -- lifecycle ---------------------------------------------------------
 

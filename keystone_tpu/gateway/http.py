@@ -14,15 +14,28 @@ A stdlib ``http.server`` on a background daemon thread, following the
 - ``GET /readyz`` — 200 while the gateway admits, 503 once draining.
   READINESS, not liveness: the admin endpoint's ``/healthz`` answers
   "is the process up", this answers "should the load balancer route
-  here" — a draining gateway is alive but not ready. A convenience
-  ``GET /healthz`` is also served for single-port deployments.
+  here" — a draining gateway is alive but not ready. With SLOs
+  declared, an active burn/pressure state is appended to the body
+  (still 200: burning means "send less", not "stop sending"). A
+  convenience ``GET /healthz`` is also served for single-port
+  deployments.
 - ``GET /metrics`` — Prometheus exposition of the (global) registry,
-  so a gateway-only deployment is scrapeable without the admin server.
+  so a gateway-only deployment is scrapeable without the admin server
+  (latency-histogram buckets carry ``trace_id`` exemplars).
+- ``GET /slz`` / ``GET /debugz`` — the SLO burn-rate and
+  flight-recorder surfaces, mirrored from the admin endpoint for
+  single-port deployments.
 - ``POST /swap`` — force one lifecycle iteration
   (``Gateway.rebucket(force=True)``); returns the active bucket set.
   The smoke script's forced-swap drill.
 - ``POST /drain`` — begin graceful shutdown in the background;
   ``/readyz`` flips 503 immediately, admitted requests resolve.
+
+With ``--request-log`` (or ``GatewayServer(request_log=True)``) every
+``/predict`` instance also emits one structured JSON line to stdout —
+``{"ts", "status", "latency_ms", "lane", "trace_id"}`` — so a
+flight-recorder trace id found at ``/debugz`` is greppable straight
+from the process log.
 """
 
 from __future__ import annotations
@@ -30,14 +43,17 @@ from __future__ import annotations
 import json
 import logging
 import threading
-from typing import Any
-from urllib.parse import urlparse
+import time
+from typing import Any, Optional
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
 from keystone_tpu.gateway.admission import Overloaded
 from keystone_tpu.gateway.lifecycle import Gateway
+from keystone_tpu.observability import flight as flight_mod
 from keystone_tpu.observability import prometheus
+from keystone_tpu.observability import slo as slo_mod
 from keystone_tpu.observability.httpd import BackgroundServer, JsonHandler
 from keystone_tpu.observability.registry import get_global_registry
 
@@ -65,32 +81,81 @@ class _Handler(JsonHandler):
         return self.server.gateway  # type: ignore[attr-defined]
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib handler API)
-        path = urlparse(self.path).path
+        url = urlparse(self.path)
+        path = url.path
         try:
             if path == "/readyz":
                 if self.gateway.ready:
-                    self._send_text(200, "ok\n")
+                    status = self.gateway.slo_status()
+                    if status is not None and (
+                        status["pressure"] > 0 or status["breaching"]
+                    ):
+                        # burning is visible here but still 200: the
+                        # LB should keep routing, admission itself is
+                        # doing the early shedding
+                        self._send_text(
+                            200,
+                            "ok (slo burning: "
+                            f"pressure={status['pressure']:.2f} "
+                            f"fast={status['burn_rate'].get('fast')})\n",
+                        )
+                    else:
+                        self._send_text(200, "ok\n")
                 else:
                     self._send_text(503, "draining\n")
             elif path == "/healthz":
                 self._send_text(200, "ok\n")
             elif path == "/metrics":
                 registry = self.server.registry  # type: ignore[attr-defined]
-                body = prometheus.render(registry.collect())
-                self._send(
-                    200, body.encode("utf-8"), prometheus.CONTENT_TYPE
+                body, ctype = prometheus.negotiate_render(
+                    registry.collect(), self.headers.get("Accept")
                 )
+                self._send(200, body.encode("utf-8"), ctype)
+            elif path == "/slz":
+                self._send_json(slo_mod.slz_status(), indent=1)
+            elif path == "/debugz":
+                q = parse_qs(url.query)
+                code, doc = flight_mod.debugz_document(
+                    q.get("trace_id", [None])[0],
+                    q.get("format", [""])[0],
+                )
+                self._send_json(doc, code=code, indent=1)
             else:
                 self._send_text(
                     404,
-                    "not found; try /predict /readyz /healthz /metrics\n",
+                    "not found; try /predict /readyz /healthz /metrics "
+                    "/slz /debugz\n",
                 )
         except Exception as e:
             logger.exception("gateway GET error for %s", self.path)
             self._send_error_json(500, "internal", detail=str(e))
 
+    def _log_request(
+        self,
+        status: int,
+        latency_s: float,
+        lane: Optional[int] = None,
+        trace_id: Optional[str] = None,
+        error: Optional[str] = None,
+    ) -> None:
+        """One structured JSON line per /predict instance on stdout
+        (``--request-log``): trace ids surfaced at /debugz are
+        greppable straight from the process log."""
+        line = {
+            "ts": round(time.time(), 6),
+            "path": "/predict",
+            "status": status,
+            "latency_ms": round(latency_s * 1e3, 3),
+            "lane": lane,
+            "trace_id": trace_id,
+        }
+        if error is not None:
+            line["error"] = error
+        print(json.dumps(line), flush=True)
+
     def do_POST(self) -> None:  # noqa: N802 (stdlib handler API)
         path = urlparse(self.path).path
+        self._t_post = time.perf_counter()
         try:
             if path == "/predict":
                 self._predict()
@@ -112,12 +177,23 @@ class _Handler(JsonHandler):
             else:
                 self._send_text(404, "not found; try /predict /swap /drain\n")
         except Overloaded as e:
+            code = _status_for(e)
+            if path == "/predict" and self.server.request_log:  # type: ignore[attr-defined]
+                self._log_request(
+                    code, time.perf_counter() - self._t_post,
+                    error=e.reason,
+                )
             self._send_error_json(
-                _status_for(e), "overloaded", reason=e.reason,
+                code, "overloaded", reason=e.reason,
                 detail=str(e),
             )
         except Exception as e:
             logger.exception("gateway POST error for %s", self.path)
+            if path == "/predict" and self.server.request_log:  # type: ignore[attr-defined]
+                self._log_request(
+                    500, time.perf_counter() - self._t_post,
+                    error=str(e),
+                )
             self._send_error_json(500, "internal", detail=str(e))
 
     def _read_body(self) -> bytes:
@@ -183,8 +259,25 @@ class _Handler(JsonHandler):
         except Exception as e:
             for f in futures:
                 f.cancel()
+            if self.server.request_log:  # type: ignore[attr-defined]
+                self._log_request(
+                    500, time.perf_counter() - self._t_post,
+                    error=str(e),
+                )
             self._send_error_json(500, "prediction_failed", detail=str(e))
             return
+        if self.server.request_log:  # type: ignore[attr-defined]
+            whole_post_s = time.perf_counter() - self._t_post
+            for f in futures:
+                # per-request latency as the admission layer measured
+                # it (rides the future) — iterating result() above
+                # would charge every instance the wait on instance 0
+                self._log_request(
+                    200,
+                    getattr(f, "latency_s", None) or whole_post_s,
+                    lane=getattr(f, "lane_index", None),
+                    trace_id=getattr(f, "trace_id", None),
+                )
         self._send_json({"predictions": [p.tolist() for p in preds]})
 
 
@@ -203,6 +296,7 @@ class GatewayServer(BackgroundServer):
         host: str = "127.0.0.1",
         registry=None,
         input_dtype: Any = np.float32,
+        request_log: bool = False,
     ):
         super().__init__(port=port, host=host)
         self.gateway = gateway
@@ -210,11 +304,13 @@ class GatewayServer(BackgroundServer):
             registry if registry is not None else get_global_registry()
         )
         self.input_dtype = np.dtype(input_dtype)
+        self.request_log = bool(request_log)
 
     def _configure(self, httpd) -> None:
         httpd.gateway = self.gateway
         httpd.registry = self.registry
         httpd.input_dtype = self.input_dtype
+        httpd.request_log = self.request_log
 
 
 def main(argv=None) -> int:
@@ -244,6 +340,21 @@ def main(argv=None) -> int:
                     help="default per-request deadline")
     ap.add_argument("--rebucket-interval", type=float, default=None,
                     help="seconds between autoscale/rebucket sweeps")
+    ap.add_argument("--slo-latency-ms", type=float, default=None,
+                    help="declare + enforce a latency SLO at this "
+                    "threshold: burn-rate gauges + /slz, admission "
+                    "tightening under sustained fast-window burn, and "
+                    "tail-sampled forensics at /debugz (enables span "
+                    "tracing)")
+    ap.add_argument("--slo-target", type=float, default=0.99,
+                    help="fraction of requests that must make the "
+                    "latency threshold")
+    ap.add_argument("--flight-capacity", type=int, default=64,
+                    help="forensic ring size (requests)")
+    ap.add_argument("--request-log", action="store_true",
+                    help="one structured JSON line per /predict "
+                    "instance on stdout (status, latency_ms, lane, "
+                    "trace_id)")
     ap.add_argument("--d", type=int, default=256)
     ap.add_argument("--hidden", type=int, default=512)
     ap.add_argument("--depth", type=int, default=4)
@@ -251,6 +362,13 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if not args.no_cache:
         setup_compilation_cache()
+
+    if args.slo_latency_ms is not None:
+        # the forensic chain (exemplars, flight records, burn gauges)
+        # keys off trace ids, so SLO mode implies tracing
+        from keystone_tpu.observability import enable_tracing
+
+        enable_tracing()
 
     fitted = build_pipeline(d=args.d, hidden=args.hidden, depth=args.depth)
     gateway = Gateway(
@@ -262,12 +380,21 @@ def main(argv=None) -> int:
         max_pending=args.max_pending,
         default_deadline_ms=args.deadline_ms,
         maintenance_interval_s=args.rebucket_interval,
+        slo_latency_s=(
+            args.slo_latency_ms / 1e3
+            if args.slo_latency_ms is not None else None
+        ),
+        slo_target=args.slo_target,
+        flight_capacity=args.flight_capacity,
     )
     gateway.install_signal_handlers()
-    server = GatewayServer(gateway, port=args.port, host=args.host).start()
+    server = GatewayServer(
+        gateway, port=args.port, host=args.host,
+        request_log=args.request_log,
+    ).start()
     print(
         f"gateway: {server.url()} (POST /predict, GET /readyz, "
-        "GET /metrics, POST /swap, POST /drain)",
+        "GET /metrics, GET /slz, GET /debugz, POST /swap, POST /drain)",
         flush=True,
     )
     try:

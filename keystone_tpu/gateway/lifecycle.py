@@ -20,11 +20,22 @@ front of an ``EnginePool`` — and owns everything about the engines'
   ``install_signal_handlers``) flips readiness (``/readyz`` goes 503 so
   load balancers stop sending), stops admitting (typed
   ``Overloaded('closed')``), drains the admission queue, and flushes
-  every lane's micro-batcher so already-admitted requests resolve.
+  every lane's micro-batcher so already-admitted requests resolve;
+- **SLO enforcement + forensics** (``slo_latency_s=``) — declares a
+  latency SLO (and an availability SLO) over the gateway's own metric
+  series, samples multi-window burn rates (``observability/slo.py``),
+  and runs a *watchdog*: a sustained fast-window burn tightens
+  admission (``AdmissionController.set_pressure`` — shed early, with
+  reason ``slo_pressure``, before the queue saturates) and relaxes it
+  once the burn subsides. The same threshold drives the tail-sampling
+  flight recorder: requests that breach it (or error) get their full
+  span tree pinned for ``/debugz``.
 
 Readiness vs liveness: ``ready`` is a routing signal (admitting and
 warmed) — the admin endpoint's ``/healthz`` stays the liveness probe
-(process up), and a draining gateway is alive but not ready.
+(process up), and a draining gateway is alive but not ready. The burn
+state is surfaced in ``/readyz``'s body (still 200 — burning is a
+"stop sending so fast", not a "stop sending").
 """
 
 from __future__ import annotations
@@ -38,6 +49,8 @@ from typing import Any, Dict, Optional, Sequence
 from keystone_tpu.gateway.admission import AdmissionController, Overloaded
 from keystone_tpu.gateway.metrics import GatewayMetrics
 from keystone_tpu.gateway.pool import EnginePool
+from keystone_tpu.observability.flight import FlightRecorder
+from keystone_tpu.observability.slo import Slo, SloMonitor
 from keystone_tpu.serving.autoscale import suggest_buckets
 from keystone_tpu.serving.engine import DEFAULT_BUCKETS
 
@@ -46,6 +59,13 @@ logger = logging.getLogger(__name__)
 # observations required before an UNFORCED rebucket may act: a proposal
 # from a handful of requests is noise, not traffic
 MIN_REBUCKET_OBSERVATIONS = 64
+
+# SLO watchdog defaults: tighten admission after the fast-window burn
+# holds >= SHED_BURN for SUSTAIN consecutive samples; relax once it
+# falls back under 1.0 (budget no longer being consumed too fast)
+SLO_SHED_BURN = 4.0
+SLO_SUSTAIN_SAMPLES = 2
+SLO_PRESSURE = 0.75
 
 
 class Gateway:
@@ -68,6 +88,23 @@ class Gateway:
                        (None/0 = off; ``rebucket()`` stays callable).
     rebucket_k:        bucket-set size the autoscaler proposes
                        (default: len(buckets)).
+    slo_latency_s:     declare + enforce a latency SLO at this
+                       threshold (None = whole SLO/forensics plane off,
+                       zero overhead): burn-rate monitoring, the
+                       admission-tightening watchdog, and tail-sampled
+                       flight recording all hang off it.
+    slo_target:        fraction of requests that must make the latency
+                       threshold (error budget = 1 - target).
+    slo_availability_target: fraction of requests that must not error.
+    slo_fast_window_s / slo_slow_window_s / slo_sample_interval_s:
+                       burn-rate evaluation windows and sampling period
+                       (tests shrink these to milliseconds).
+    slo_shed_burn:     fast-window burn rate that (sustained for
+                       ``slo_sustain_samples``) trips admission
+                       tightening.
+    slo_pressure:      how hard the watchdog tightens (queue bound
+                       shrinks to ``max_pending * (1 - pressure)``).
+    flight_capacity:   forensic ring size (records, not spans).
     """
 
     def __init__(
@@ -85,6 +122,16 @@ class Gateway:
         rebucket_k: Optional[int] = None,
         name: str = "gateway",
         registry=None,
+        slo_latency_s: Optional[float] = None,
+        slo_target: float = 0.99,
+        slo_availability_target: float = 0.999,
+        slo_fast_window_s: float = 60.0,
+        slo_slow_window_s: float = 1800.0,
+        slo_sample_interval_s: float = 5.0,
+        slo_shed_burn: float = SLO_SHED_BURN,
+        slo_sustain_samples: int = SLO_SUSTAIN_SAMPLES,
+        slo_pressure: float = SLO_PRESSURE,
+        flight_capacity: int = 64,
     ):
         self.name = name
         self.fitted = fitted
@@ -105,12 +152,52 @@ class Gateway:
         )
         if warmup_example is not None:
             self.pool.warmup(warmup_example)
+        # -- SLO + forensics plane (off unless a latency SLO declared) -
+        self.flight: Optional[FlightRecorder] = None
+        self.slo_monitor: Optional[SloMonitor] = None
+        self._latency_slo: Optional[Slo] = None
+        self._slo_shed_burn = float(slo_shed_burn)
+        self._slo_sustain_samples = int(slo_sustain_samples)
+        self._slo_pressure = float(slo_pressure)
+        self._slo_hot_samples = 0
+        if slo_latency_s is not None:
+            self.flight = FlightRecorder(
+                flight_capacity,
+                latency_threshold_s=slo_latency_s,
+                registry=registry,
+            )
+            self.slo_monitor = SloMonitor(
+                fast_window_s=slo_fast_window_s,
+                slow_window_s=slo_slow_window_s,
+                registry=registry,
+            )
+            self._latency_slo = self.slo_monitor.add(
+                Slo.latency(
+                    f"{name}:latency",
+                    self.metrics.request_latency,
+                    threshold_s=slo_latency_s,
+                    target=slo_target,
+                    labels=(name,),
+                )
+            )
+            self.slo_monitor.add(
+                Slo.availability(
+                    f"{name}:availability",
+                    self.metrics.requests_total,
+                    target=slo_availability_target,
+                    base_labels=(name,),
+                )
+            )
+            self.slo_monitor.add_listener(self._slo_watchdog)
+            self.slo_monitor.start(slo_sample_interval_s)
         self.admission = AdmissionController(
             self.pool,
             max_pending=max_pending,
             default_deadline_ms=default_deadline_ms,
             metrics=self.metrics,
             name=name,
+            flight=self.flight,
+            forensic_threshold_s=slo_latency_s,
         )
         self._closed = False
         self._close_lock = threading.Lock()
@@ -153,6 +240,60 @@ class Gateway:
     @property
     def buckets(self) -> tuple:
         return self._buckets
+
+    # -- SLO watchdog ------------------------------------------------------
+
+    def _slo_watchdog(self, monitor: SloMonitor) -> None:
+        """Runs after every burn-rate sample: a sustained fast-window
+        burn tightens admission (shed early, before the queue
+        saturates); the pressure releases once the burn drops back
+        under 1.0 — budget consumption at a sustainable rate again."""
+        burns = monitor.burn_rates(self._latency_slo.name)
+        fast = burns.get("fast")
+        if fast is None:
+            return
+        if fast >= self._slo_shed_burn:
+            self._slo_hot_samples += 1
+            if (
+                self._slo_hot_samples >= self._slo_sustain_samples
+                and self.admission.pressure == 0.0
+            ):
+                self.admission.set_pressure(self._slo_pressure)
+                self.metrics.set_slo_pressure(self._slo_pressure)
+                logger.warning(
+                    "gateway %s: fast-window SLO burn %.1f sustained "
+                    "%d samples; tightening admission (pressure %.2f)",
+                    self.name, fast, self._slo_hot_samples,
+                    self._slo_pressure,
+                )
+        else:
+            # "sustained" means CONSECUTIVE over-threshold samples: any
+            # cooler sample resets the streak, so isolated spikes hours
+            # apart can never accumulate into a tightening
+            self._slo_hot_samples = 0
+            if fast < 1.0 and self.admission.pressure > 0.0:
+                # release only once consumption is back under the
+                # sustainable rate (hysteresis between shed_burn and 1)
+                self.admission.set_pressure(0.0)
+                self.metrics.set_slo_pressure(0.0)
+                logger.info(
+                    "gateway %s: SLO burn subsided (fast %.2f); "
+                    "admission pressure released", self.name, fast,
+                )
+
+    def slo_status(self) -> Optional[Dict]:
+        """The burn state ``/readyz`` surfaces (None with no SLOs)."""
+        if self.slo_monitor is None or self._latency_slo is None:
+            return None
+        return {
+            "pressure": self.admission.pressure,
+            "burn_rate": self.slo_monitor.burn_rates(
+                self._latency_slo.name
+            ),
+            "breaching": self.slo_monitor.breaching(
+                self._latency_slo.name
+            ),
+        }
 
     # -- the live autoscale loop -------------------------------------------
 
@@ -235,6 +376,8 @@ class Gateway:
             self._drained.wait(timeout)
             return
         self._maint_stop.set()
+        if self.slo_monitor is not None:
+            self.slo_monitor.stop()
         self.admission.close(timeout=timeout)
         self.pool.close(timeout=timeout)
         if self._maint is not None:

@@ -15,13 +15,16 @@ shared):
 - ``keystone_gateway_requests_total{gateway,status}`` — terminal
   request outcomes: ``ok`` | ``shed`` | ``error``.
 - ``keystone_gateway_shed_total{gateway,reason}`` — load-shed detail:
-  ``queue_full`` | ``deadline`` | ``expired`` | ``closed``.
+  ``queue_full`` | ``slo_pressure`` | ``deadline`` | ``expired`` |
+  ``closed``.
 - ``keystone_gateway_retries_total{gateway}`` — lane-failure retries.
 - ``keystone_gateway_engine_swaps_total{gateway}`` — live re-buckets.
 - ``keystone_gateway_queue_depth{gateway}`` / ``_inflight`` /
-  ``_ready`` gauges.
+  ``_ready`` / ``_slo_pressure`` gauges.
 - ``keystone_gateway_queue_wait_seconds`` /
-  ``keystone_gateway_request_latency_seconds`` histograms.
+  ``keystone_gateway_request_latency_seconds`` histograms; the latency
+  histogram's buckets carry ``trace_id`` OpenMetrics exemplars when the
+  request was traced, linking the aggregate to ``/debugz`` forensics.
 """
 
 from __future__ import annotations
@@ -80,6 +83,12 @@ class GatewayMetrics:
             "1 while the gateway admits traffic, 0 once draining",
             ("gateway",),
         )
+        self._slo_pressure = reg.gauge(
+            "keystone_gateway_slo_pressure",
+            "admission tightening applied by the SLO burn watchdog "
+            "(0 = none, toward 1 = queue bound shrunk)",
+            ("gateway",),
+        )
         self.queue_wait = reg.histogram(
             "keystone_gateway_queue_wait_seconds",
             "admission-queue wait (admit to lane hand-off)",
@@ -93,6 +102,12 @@ class GatewayMetrics:
         self.set_ready(False)
         self.set_queue_depth(0)
         self.set_inflight(0)
+        self.set_slo_pressure(0.0)
+
+    @property
+    def requests_total(self):
+        """The outcome counter handle (the availability SLO reads it)."""
+        return self._requests
 
     # -- thin label-bound helpers (hot path: one tuple + one inc) ----------
 
@@ -112,8 +127,15 @@ class GatewayMetrics:
     def record_queue_wait(self, seconds: float) -> None:
         self.queue_wait.observe(seconds, (self.gateway,))
 
-    def record_latency(self, seconds: float) -> None:
-        self.request_latency.observe(seconds, (self.gateway,))
+    def record_latency(
+        self, seconds: float, trace_id: Optional[str] = None
+    ) -> None:
+        self.request_latency.observe(
+            seconds, (self.gateway,), trace_id=trace_id
+        )
+
+    def set_slo_pressure(self, pressure: float) -> None:
+        self._slo_pressure.set(pressure, (self.gateway,))
 
     def set_queue_depth(self, depth: int) -> None:
         self._queue_depth.set(depth, (self.gateway,))

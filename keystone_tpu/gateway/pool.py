@@ -241,6 +241,10 @@ class EnginePool:
             )
             return
         tried.append(lane)
+        # which lane served this request (the LAST one tried wins on a
+        # retry) — the admission layer copies it onto the caller-facing
+        # future for the request log and flight-recorder attrs
+        out.lane_index = lane.index
         try:
             fut = lane.submit(example, parent_span_id=parent_span_id)
         except Exception as e:

@@ -28,11 +28,18 @@ consistent surface.
 
 from keystone_tpu.observability.admin import (
     AdminServer,
+    build_info,
     start_admin_server,
     stop_admin_server,
 )
+from keystone_tpu.observability.flight import (
+    FlightRecord,
+    FlightRecorder,
+)
+from keystone_tpu.observability.otlp import OtlpSpanExporter
 from keystone_tpu.observability.registry import (
     DEFAULT_HISTOGRAM_BUCKETS,
+    Exemplar,
     MetricFamily,
     MetricsRegistry,
     RegistryHistogram,
@@ -40,6 +47,7 @@ from keystone_tpu.observability.registry import (
     get_global_registry,
     reset_global_registry,
 )
+from keystone_tpu.observability.slo import Slo, SloMonitor
 from keystone_tpu.observability.tracing import (
     Span,
     Tracer,
@@ -51,12 +59,19 @@ from keystone_tpu.observability.tracing import (
 __all__ = [
     "AdminServer",
     "DEFAULT_HISTOGRAM_BUCKETS",
+    "Exemplar",
+    "FlightRecord",
+    "FlightRecorder",
     "MetricFamily",
     "MetricsRegistry",
+    "OtlpSpanExporter",
     "RegistryHistogram",
     "Sample",
+    "Slo",
+    "SloMonitor",
     "Span",
     "Tracer",
+    "build_info",
     "disable_tracing",
     "enable_tracing",
     "get_global_registry",

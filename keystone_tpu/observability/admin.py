@@ -1,4 +1,5 @@
-"""Admin HTTP endpoint: ``/metrics``, ``/varz``, ``/healthz``, ``/tracez``.
+"""Admin HTTP endpoint: ``/metrics``, ``/varz``, ``/healthz``,
+``/tracez``, ``/slz``, ``/debugz``.
 
 Built on the shared scaffolding in ``observability/httpd.py`` — a
 stdlib ``http.server`` on a background daemon thread, nothing to
@@ -10,11 +11,18 @@ install, nothing running unless ``AdminServer.start()`` (or the
   but not ready)
 - ``GET /metrics``  -> Prometheus text exposition v0.0.4 of the global
   (or injected) ``MetricsRegistry`` — scrape target for Prometheus /
-  the autoscaler
-- ``GET /varz``     -> the same registry as one JSON document
+  the autoscaler; histogram buckets may carry OpenMetrics exemplars
+- ``GET /varz``     -> the same registry as one JSON document, plus a
+  ``build`` block (git SHA, start time/uptime, jax version, device
+  kind) so two scrapes of different binaries are distinguishable
 - ``GET /tracez``   -> recent spans from the tracer as JSON
   (``?format=chrome`` returns Chrome trace-event JSON for
   chrome://tracing / Perfetto; ``?n=100`` bounds the span count)
+- ``GET /slz``      -> every live ``SloMonitor``'s objectives with
+  fast/slow-window burn rates and breach verdicts
+- ``GET /debugz``   -> the flight recorders' tail-sampled forensic
+  records (``?trace_id=`` filters to one request;
+  ``&format=chrome`` dumps that request as a Chrome trace)
 
 Binding defaults to localhost; ``port=0`` picks an ephemeral port
 (``server.port`` reports the real one — tests and the smoke script use
@@ -24,11 +32,14 @@ that).
 from __future__ import annotations
 
 import logging
+import os
+import platform
 import threading
-from typing import Optional
+import time
+from typing import Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
-from keystone_tpu.observability import prometheus
+from keystone_tpu.observability import flight, prometheus, slo
 from keystone_tpu.observability.httpd import BackgroundServer, JsonHandler
 from keystone_tpu.observability.registry import (
     MetricsRegistry,
@@ -37,6 +48,100 @@ from keystone_tpu.observability.registry import (
 from keystone_tpu.observability.tracing import Tracer, get_tracer
 
 logger = logging.getLogger(__name__)
+
+_PROCESS_START_S = time.time()
+_git_sha_cache: Optional[str] = None
+_git_sha_read = False
+
+
+def _git_sha() -> Optional[str]:
+    """Best-effort repo SHA of the running checkout (one subprocess,
+    cached; None outside a git checkout or without git)."""
+    global _git_sha_cache, _git_sha_read
+    if _git_sha_read:
+        return _git_sha_cache
+    _git_sha_read = True
+    try:
+        import subprocess
+
+        out = subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+        )
+        if out.returncode == 0:
+            _git_sha_cache = out.stdout.strip() or None
+    except Exception:
+        _git_sha_cache = None
+    return _git_sha_cache
+
+
+_build_static: Optional[Dict] = None
+_build_static_lock = threading.Lock()
+
+
+def _static_build_info() -> Dict:
+    """The immutable part of the identity, computed ONCE: every
+    ``/metrics`` scrape and ``/varz`` hit reads ``build_info``, and
+    ``jax.devices()`` can trigger full backend initialization — a
+    multi-second side effect a monitoring poll must pay at most once,
+    not per scrape."""
+    global _build_static
+    with _build_static_lock:
+        if _build_static is None:
+            info: Dict = {
+                "git_sha": _git_sha(),
+                "start_time_unix_s": _PROCESS_START_S,
+                "pid": os.getpid(),
+                "python_version": platform.python_version(),
+                "jax_version": None,
+                "device_kind": None,
+            }
+            try:  # best-effort: jax is a hard dep, but the backend
+                import jax  # may fail to init on this host
+
+                info["jax_version"] = jax.__version__
+                devices = jax.devices()
+                if devices:
+                    info["device_kind"] = devices[0].device_kind
+                    info["device_count"] = len(devices)
+            except Exception:
+                pass
+            _build_static = info
+        return dict(_build_static)
+
+
+def build_info() -> Dict:
+    """Who/what this process is: enough identity that two ``/varz``
+    scrapes of different binaries are distinguishable."""
+    info = _static_build_info()
+    info["uptime_s"] = round(time.time() - _PROCESS_START_S, 3)
+    return info
+
+
+def register_build_metrics(registry: MetricsRegistry) -> None:
+    """Export identity onto the scrape surface: the standard
+    ``_info``-style constant gauge plus process start time."""
+    def info_cells():
+        info = build_info()
+        key = (
+            str(info.get("git_sha") or "unknown"),
+            str(info.get("jax_version") or "unknown"),
+            str(info.get("device_kind") or "unknown"),
+        )
+        return {key: 1.0}
+
+    registry.gauge_func(
+        "keystone_build_info",
+        info_cells,
+        "constant 1 labeled with the build/runtime identity",
+        ("git_sha", "jax_version", "device_kind"),
+    )
+    registry.gauge_func(
+        "keystone_process_start_time_seconds",
+        lambda: _PROCESS_START_S,
+        "process start time, unix epoch seconds",
+    )
 
 
 class _Handler(JsonHandler):
@@ -49,12 +154,14 @@ class _Handler(JsonHandler):
             if url.path == "/healthz":
                 self._send_text(200, "ok\n")
             elif url.path == "/metrics":
-                body = prometheus.render(registry.collect())
-                self._send(
-                    200, body.encode("utf-8"), prometheus.CONTENT_TYPE
+                body, ctype = prometheus.negotiate_render(
+                    registry.collect(), self.headers.get("Accept")
                 )
+                self._send(200, body.encode("utf-8"), ctype)
             elif url.path == "/varz":
-                self._send_json(registry.varz(), indent=1)
+                doc = registry.varz()
+                doc["build"] = build_info()
+                self._send_json(doc, indent=1)
             elif url.path == "/tracez":
                 q = parse_qs(url.query)
                 if q.get("format", [""])[0] == "chrome":
@@ -70,10 +177,20 @@ class _Handler(JsonHandler):
                         },
                         indent=1,
                     )
+            elif url.path == "/slz":
+                self._send_json(slo.slz_status(), indent=1)
+            elif url.path == "/debugz":
+                q = parse_qs(url.query)
+                code, doc = flight.debugz_document(
+                    q.get("trace_id", [None])[0],
+                    q.get("format", [""])[0],
+                )
+                self._send_json(doc, code=code, indent=1)
             else:
                 self._send_text(
                     404,
-                    "not found; try /metrics /varz /healthz /tracez\n",
+                    "not found; try /metrics /varz /healthz /tracez "
+                    "/slz /debugz\n",
                 )
         except Exception as e:  # a broken collector must not kill the
             # serving thread — report it to the scraper instead
@@ -99,6 +216,7 @@ class AdminServer(BackgroundServer):
         super().__init__(port=port, host=host)
         self.registry = registry if registry is not None else get_global_registry()
         self.tracer = tracer if tracer is not None else get_tracer()
+        register_build_metrics(self.registry)
 
     def _configure(self, httpd) -> None:
         httpd.registry = self.registry

@@ -13,18 +13,37 @@ Rules implemented:
   newline must be escaped as ``\\\\``, ``\\"`` and ``\\n``;
 - HELP text escapes backslash and newline (quotes are legal there);
 - every family gets one ``# HELP`` + ``# TYPE`` block, and the body
-  ends with a trailing newline.
+  ends with a trailing newline;
+- a histogram-bucket sample carrying an exemplar appends the
+  OpenMetrics exemplar syntax ``# {trace_id="..."} value timestamp``,
+  linking the aggregate bucket to one concrete traced request —
+  but ONLY in the OpenMetrics rendering (``render(...,
+  openmetrics=True)``; the classic v0.0.4 text parser reads the
+  mid-line ``#`` as a malformed timestamp and fails the whole scrape,
+  so the plain rendering never carries exemplar tails. The endpoints
+  content-negotiate via ``negotiate_render``: scrapers that send
+  ``Accept: application/openmetrics-text`` (a real Prometheus server
+  does by default) get exemplars + the ``# EOF`` terminator.
+
+The reverse direction lives here too: ``parse_samples`` reads an
+exposition body back into (name, labels, value) rows and
+``quantile_from_buckets`` reproduces PromQL's ``histogram_quantile``
+interpolation — so the regression bench reads its p99 from the SAME
+``/metrics`` surface operators scrape, not from bench-local counters.
 """
 
 from __future__ import annotations
 
 import math
 import re
-from typing import Iterable
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from keystone_tpu.observability.registry import MetricFamily
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
 
 _METRIC_INVALID = re.compile(r"[^a-zA-Z0-9_:]")
 _LABEL_INVALID = re.compile(r"[^a-zA-Z0-9_]")
@@ -81,7 +100,20 @@ def format_value(v: float) -> str:
     return repr(float(v))
 
 
-def render_family(family: MetricFamily) -> str:
+def format_exemplar(exemplar) -> str:
+    """The OpenMetrics exemplar tail of a bucket line:
+    ``# {trace_id="..."} value timestamp``."""
+    labelstr = ",".join(
+        f'{sanitize_label_name(k)}="{escape_label_value(v)}"'
+        for k, v in exemplar.labels.items()
+    )
+    return (
+        f" # {{{labelstr}}} {format_value(exemplar.value)}"
+        f" {repr(float(exemplar.timestamp_s))}"
+    )
+
+
+def render_family(family: MetricFamily, exemplars: bool = False) -> str:
     name = sanitize_metric_name(family.name)
     lines = []
     if family.help:
@@ -95,13 +127,130 @@ def render_family(family: MetricFamily) -> str:
             ) + "}"
         else:
             labelstr = ""
-        lines.append(f"{name}{s.suffix}{labelstr} {format_value(s.value)}")
+        line = f"{name}{s.suffix}{labelstr} {format_value(s.value)}"
+        if exemplars and getattr(s, "exemplar", None) is not None:
+            line += format_exemplar(s.exemplar)
+        lines.append(line)
     return "\n".join(lines) + "\n"
 
 
-def render(families: Iterable[MetricFamily]) -> str:
+def render(
+    families: Iterable[MetricFamily], openmetrics: bool = False
+) -> str:
     """Families (from ``MetricsRegistry.collect()``) -> the full
-    exposition body."""
-    return "".join(
-        render_family(f) for f in sorted(families, key=lambda f: f.name)
+    exposition body. ``openmetrics=True`` switches to the (best-effort)
+    OpenMetrics rendering: exemplar tails on histogram buckets plus the
+    required ``# EOF`` terminator — never emitted in the classic
+    v0.0.4 rendering, whose parsers reject mid-line ``#``."""
+    body = "".join(
+        render_family(f, exemplars=openmetrics)
+        for f in sorted(families, key=lambda f: f.name)
     )
+    if openmetrics:
+        body += "# EOF\n"
+    return body
+
+
+def negotiate_render(
+    families: Iterable[MetricFamily], accept: Optional[str]
+) -> Tuple[str, str]:
+    """Render for a scraper's ``Accept`` header -> ``(body,
+    content_type)``: the OpenMetrics rendering (exemplars) when the
+    header asks for ``application/openmetrics-text`` — a real
+    Prometheus server does by default — else classic v0.0.4 text."""
+    if accept and "application/openmetrics-text" in accept:
+        return render(families, openmetrics=True), OPENMETRICS_CONTENT_TYPE
+    return render(families), CONTENT_TYPE
+
+
+# -- reading an exposition back (scrape-side helpers) ----------------------
+
+_SAMPLE_LINE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>[^\s#]+)"
+)
+_LABEL_PAIR = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label_value(value: str) -> str:
+    return (
+        value.replace(r"\n", "\n").replace(r"\"", '"').replace("\\\\", "\\")
+    )
+
+
+def _parse_value(raw: str) -> float:
+    if raw == "+Inf":
+        return math.inf
+    if raw == "-Inf":
+        return -math.inf
+    if raw == "NaN":
+        return math.nan
+    return float(raw)
+
+
+def parse_samples(
+    text: str,
+) -> List[Tuple[str, Dict[str, str], float]]:
+    """An exposition body -> ``(name, labels, value)`` rows. Comments
+    (including exemplar tails — the regex stops at ``#``) are skipped;
+    this is the scrape-side half of the format the renderer above
+    emits, used by the regression bench to read ``/metrics``."""
+    out: List[Tuple[str, Dict[str, str], float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_LINE.match(line)
+        if not m:
+            continue
+        labels = {
+            k: _unescape_label_value(v)
+            for k, v in _LABEL_PAIR.findall(m.group("labels") or "")
+        }
+        out.append((m.group("name"), labels, _parse_value(m.group("value"))))
+    return out
+
+
+def histogram_buckets(
+    text: str, name: str, match_labels: Optional[Dict[str, str]] = None
+) -> List[Tuple[float, float]]:
+    """The cumulative ``(le, count)`` buckets of one histogram family
+    in an exposition body, ``le``-ascending (``+Inf`` last), filtered
+    to samples whose labels include ``match_labels``."""
+    match_labels = match_labels or {}
+    buckets = []
+    for sample_name, labels, value in parse_samples(text):
+        if sample_name != f"{name}_bucket" or "le" not in labels:
+            continue
+        if any(labels.get(k) != v for k, v in match_labels.items()):
+            continue
+        buckets.append((_parse_value(labels["le"]), value))
+    return sorted(buckets, key=lambda b: b[0])
+
+
+def quantile_from_buckets(
+    q: float, buckets: Sequence[Tuple[float, float]]
+) -> Optional[float]:
+    """PromQL ``histogram_quantile`` over cumulative ``(le, count)``
+    buckets: linear interpolation inside the covering bucket, lower
+    bound 0 for the first, and the highest finite bound when the
+    quantile lands in ``+Inf``. None with no observations."""
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_le, prev_count = 0.0, 0.0
+    for le, count in buckets:
+        if count >= rank:
+            if math.isinf(le):
+                return prev_le  # PromQL clamps to the last finite bound
+            if count == prev_count:
+                return le
+            return prev_le + (le - prev_le) * (
+                (rank - prev_count) / (count - prev_count)
+            )
+        prev_le, prev_count = le, count
+    return prev_le

@@ -27,6 +27,7 @@ import bisect
 import dataclasses
 import math
 import threading
+import time
 from typing import (
     Callable,
     Dict,
@@ -54,12 +55,24 @@ DEFAULT_HISTOGRAM_BUCKETS = (
 
 
 @dataclasses.dataclass
+class Exemplar:
+    """An OpenMetrics exemplar: one concrete observation (typically
+    carrying a ``trace_id``) pinned to a histogram bucket, so the
+    bucket's aggregate links back to a forensic trace."""
+
+    labels: Dict[str, str]  # e.g. {"trace_id": "4bf9..."}
+    value: float  # the exemplified observation itself
+    timestamp_s: float  # epoch seconds when it was observed
+
+
+@dataclasses.dataclass
 class Sample:
     """One exposition line: ``name+suffix{labels} value``."""
 
     suffix: str  # "" for the bare metric, "_count"/"_sum" for summaries
     labels: Dict[str, str]
     value: float
+    exemplar: Optional[Exemplar] = None
 
 
 @dataclasses.dataclass
@@ -264,11 +277,23 @@ class RegistryHistogram(_Metric):
                 f"{bounds}"
             )
         self.bounds = bounds
-        # per label set: [per-bound counts..., +Inf overflow], sum
-        self._cells: Dict[LabelValues, Tuple[List[int], List[float]]] = {}
+        # per label set: ([per-bound counts..., +Inf overflow], sum,
+        # {bucket idx -> Exemplar})
+        self._cells: Dict[
+            LabelValues, Tuple[List[int], List[float], Dict[int, Exemplar]]
+        ] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float, labels: Optional[LabelValues] = None):
+    def observe(
+        self,
+        value: float,
+        labels: Optional[LabelValues] = None,
+        trace_id: Optional[str] = None,
+    ):
+        """Record one observation. ``trace_id`` (when the caller is
+        inside a traced request) pins this observation as the bucket's
+        OpenMetrics exemplar — the scrape then links the aggregate
+        bucket straight to the flight-recorder entry for that trace."""
         values = self._check(labels)
         value = float(value)
         idx = bisect.bisect_left(self.bounds, value)
@@ -276,10 +301,14 @@ class RegistryHistogram(_Metric):
             cell = self._cells.get(values)
             if cell is None:
                 cell = self._cells[values] = (
-                    [0] * (len(self.bounds) + 1), [0.0],
+                    [0] * (len(self.bounds) + 1), [0.0], {},
                 )
             cell[0][idx] += 1
             cell[1][0] += value
+            if trace_id:
+                cell[2][idx] = Exemplar(
+                    {"trace_id": str(trace_id)}, value, time.time()
+                )
 
     def get_count(self, labels: Optional[LabelValues] = None) -> int:
         values = self._check(labels)
@@ -287,26 +316,60 @@ class RegistryHistogram(_Metric):
             cell = self._cells.get(values)
             return sum(cell[0]) if cell else 0
 
+    # -- windowed readers (the SLO evaluator's inputs) ---------------------
+
+    def le_index(self, threshold: float) -> int:
+        """Index of the smallest bound >= ``threshold``
+        (``len(bounds)`` means only +Inf covers it). The SLO layer uses
+        this to snap a latency objective onto bucket resolution."""
+        return bisect.bisect_left(self.bounds, float(threshold))
+
+    def cumulative_count(
+        self, bound_index: int, labels: Optional[LabelValues] = None
+    ) -> int:
+        """Observations <= ``bounds[bound_index]`` (cumulative ``le``
+        semantics; an index past the last bound counts everything)."""
+        values = self._check(labels)
+        with self._lock:
+            cell = self._cells.get(values)
+            if cell is None:
+                return 0
+            return sum(cell[0][: bound_index + 1])
+
+    def get_sum(self, labels: Optional[LabelValues] = None) -> float:
+        values = self._check(labels)
+        with self._lock:
+            cell = self._cells.get(values)
+            return cell[1][0] if cell else 0.0
+
     def collect(self) -> MetricFamily:
         with self._lock:
             cells = {
-                k: (list(counts), totals[0])
-                for k, (counts, totals) in self._cells.items()
+                k: (list(counts), totals[0], dict(exemplars))
+                for k, (counts, totals, exemplars) in self._cells.items()
             }
         # local import: prometheus.py imports MetricFamily from here
         from keystone_tpu.observability.prometheus import format_le
 
         samples: List[Sample] = []
-        for values, (counts, total) in sorted(cells.items()):
+        for values, (counts, total, exemplars) in sorted(cells.items()):
             base = _label_dict(self.labelnames, values)
             cum = 0
-            for bound, c in zip(self.bounds, counts):
+            for i, (bound, c) in enumerate(zip(self.bounds, counts)):
                 cum += c
                 samples.append(
-                    Sample("_bucket", {**base, "le": format_le(bound)}, cum)
+                    Sample(
+                        "_bucket", {**base, "le": format_le(bound)}, cum,
+                        exemplar=exemplars.get(i),
+                    )
                 )
             cum += counts[-1]
-            samples.append(Sample("_bucket", {**base, "le": "+Inf"}, cum))
+            samples.append(
+                Sample(
+                    "_bucket", {**base, "le": "+Inf"}, cum,
+                    exemplar=exemplars.get(len(self.bounds)),
+                )
+            )
             samples.append(Sample("_count", base, cum))
             samples.append(Sample("_sum", base, total))
         return MetricFamily(self.name, self.mtype, self.help, samples)

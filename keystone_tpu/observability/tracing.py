@@ -28,11 +28,23 @@ import json
 import os
 import threading
 import time
-from typing import Any, Deque, Dict, List, Optional
+from typing import Any, Callable, Deque, Dict, List, Optional
 
 DEFAULT_CAPACITY = 2048
 
+# span_id -> trace_id entries kept for cross-thread parent pinning (the
+# pinned parent has usually FINISHED by the time its child starts — the
+# gateway.admit span ends at submit-return, the micro-batch window
+# opens later on the dispatcher thread)
+TRACE_MAP_CAPACITY = 8192
+
 _ids = itertools.count(1)
+
+
+def new_trace_id() -> str:
+    """A fresh 128-bit trace id as 32 lowercase hex chars (the OTLP /
+    W3C trace-context wire width, and the exemplar label value)."""
+    return os.urandom(16).hex()
 
 
 @dataclasses.dataclass
@@ -44,12 +56,14 @@ class Span:
     duration_s: float
     thread_id: int
     attrs: Dict[str, Any]
+    trace_id: Optional[str] = None  # shared by every span of one request
 
     def to_dict(self) -> Dict[str, Any]:
         return {
             "name": self.name,
             "span_id": self.span_id,
             "parent_id": self.parent_id,
+            "trace_id": self.trace_id,
             "start_s": self.start_s,
             "duration_ms": round(self.duration_s * 1e3, 6),
             "thread_id": self.thread_id,
@@ -61,12 +75,21 @@ class _ActiveSpan:
     """A span in flight; exposes ``set_attr`` and is the context object
     ``Tracer.span()`` yields."""
 
-    __slots__ = ("name", "span_id", "parent_id", "attrs", "_t0", "_wall")
+    __slots__ = (
+        "name", "span_id", "parent_id", "trace_id", "attrs", "_t0", "_wall",
+    )
 
-    def __init__(self, name: str, parent_id: Optional[int], attrs: Dict):
+    def __init__(
+        self,
+        name: str,
+        parent_id: Optional[int],
+        attrs: Dict,
+        trace_id: Optional[str] = None,
+    ):
         self.name = name
         self.span_id = next(_ids)
         self.parent_id = parent_id
+        self.trace_id = trace_id if trace_id is not None else new_trace_id()
         self.attrs = attrs
         self._t0 = time.perf_counter()
         self._wall = time.time()
@@ -81,6 +104,7 @@ class _NullSpan:
     __slots__ = ()
     span_id = None
     parent_id = None
+    trace_id = None
 
     def set_attr(self, key: str, value: Any) -> None:
         pass
@@ -103,6 +127,14 @@ class Tracer:
         self._ring: Deque[Span] = collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._local = threading.local()
+        # span_id -> trace_id for recently started spans, so a child
+        # pinned to a cross-thread parent_id joins the parent's trace
+        # even after the parent finished; bounded FIFO
+        self._trace_map: Dict[int, str] = {}
+        self._trace_order: Deque[int] = collections.deque()
+        # sinks observe every FINISHED span (the OTLP exporter installs
+        # here); empty list = zero per-span overhead beyond the check
+        self._sinks: List[Callable[[Span], None]] = []
 
     # -- span lifecycle ----------------------------------------------------
 
@@ -123,10 +155,23 @@ class Tracer:
         if not self.enabled:
             return _NULL_SPAN
         stack = self._stack()
+        trace_id = None
         if parent_id is None:
-            parent_id = stack[-1].span_id if stack else None
-        span = _ActiveSpan(name, parent_id, attrs)
+            if stack:
+                parent_id = stack[-1].span_id
+                trace_id = stack[-1].trace_id
+        else:
+            # explicit cross-thread parent: join its trace if we still
+            # know it (bounded map); else this span roots a new trace
+            with self._lock:
+                trace_id = self._trace_map.get(parent_id)
+        span = _ActiveSpan(name, parent_id, attrs, trace_id=trace_id)
         stack.append(span)
+        with self._lock:
+            self._trace_map[span.span_id] = span.trace_id
+            self._trace_order.append(span.span_id)
+            while len(self._trace_order) > TRACE_MAP_CAPACITY:
+                self._trace_map.pop(self._trace_order.popleft(), None)
         return span
 
     def end_span(self, span: _ActiveSpan) -> Optional[Span]:
@@ -140,13 +185,35 @@ class Tracer:
             duration_s=time.perf_counter() - span._t0,
             thread_id=threading.get_ident(),
             attrs=span.attrs,
+            trace_id=span.trace_id,
         )
         stack = self._stack()
         if span in stack:  # tolerate out-of-order ends
             stack.remove(span)
         with self._lock:
             self._ring.append(done)
+            sinks = list(self._sinks) if self._sinks else None
+        if sinks:
+            for sink in sinks:
+                try:
+                    sink(done)
+                except Exception:  # a broken exporter must not break
+                    pass  # the instrumented hot path
         return done
+
+    # -- sinks (span exporters) --------------------------------------------
+
+    def add_sink(self, fn: Callable[[Span], None]) -> None:
+        """``fn`` observes every finished span (called outside the
+        instrumented code path's locks; exceptions are swallowed)."""
+        with self._lock:
+            if fn not in self._sinks:
+                self._sinks.append(fn)
+
+    def remove_sink(self, fn: Callable[[Span], None]) -> None:
+        with self._lock:
+            if fn in self._sinks:
+                self._sinks.remove(fn)
 
     @contextlib.contextmanager
     def _span_cm(
@@ -180,6 +247,14 @@ class Tracer:
             spans = list(self._ring)
         return spans if n is None else spans[-n:]
 
+    def spans_for_trace(self, trace_id: str) -> List[Span]:
+        """Every finished span of one trace still in the ring, oldest
+        first — the flight recorder's span-tree source."""
+        if not trace_id:
+            return []
+        with self._lock:
+            return [s for s in self._ring if s.trace_id == trace_id]
+
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
@@ -204,6 +279,7 @@ class Tracer:
                         **s.attrs,
                         "span_id": s.span_id,
                         "parent_id": s.parent_id,
+                        "trace_id": s.trace_id,
                     },
                 }
             )
@@ -226,10 +302,16 @@ def get_tracer() -> Tracer:
 
 
 def enable_tracing(capacity: Optional[int] = None) -> Tracer:
-    if capacity is not None and capacity != _global_tracer._ring.maxlen:
-        _global_tracer._ring = collections.deque(
-            _global_tracer._ring, maxlen=capacity
-        )
+    if capacity is not None:
+        # the ring replacement must be atomic with concurrent end_span
+        # appenders (they append under the same lock) — an unguarded
+        # rebuild raced writers into the deque being copied and lost
+        # their spans (or tripped RuntimeError on mutation-during-copy)
+        with _global_tracer._lock:
+            if capacity != _global_tracer._ring.maxlen:
+                _global_tracer._ring = collections.deque(
+                    _global_tracer._ring, maxlen=capacity
+                )
     _global_tracer.enabled = True
     return _global_tracer
 

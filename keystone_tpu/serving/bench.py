@@ -15,7 +15,10 @@
 - ``serving_gateway_p99`` — the same concurrent single-example load
   pushed through the FULL request plane (``keystone_tpu/gateway/``:
   admission -> lane routing -> micro-batch -> engine); the delta over
-  ``serving_microbatch_p99`` prices the gateway layer.
+  ``serving_microbatch_p99`` prices the gateway layer. The value is
+  read by scraping the gateway's ``/metrics`` histogram (PromQL-style
+  ``histogram_quantile`` over the exported ``le`` buckets), so the
+  regression row IS the series operators alert on.
 - ``serving_swap_blip`` — p99 latency of requests issued while a forced
   live engine swap runs under steady load (zero failures asserted) —
   the cost of closing the autoscale loop live.
@@ -215,12 +218,24 @@ def bench_gateway(
     """``serving_gateway_p99`` — p99 end-to-end latency through the FULL
     request plane (admission queue -> lane routing -> micro-batch ->
     engine) under concurrent load; comparable against the bare
-    ``serving_microbatch_p99`` row to price the gateway layer."""
+    ``serving_microbatch_p99`` row to price the gateway layer.
+
+    The headline value is read by SCRAPING the gateway's own
+    ``/metrics`` (``keystone_gateway_request_latency_seconds`` buckets
+    -> ``histogram_quantile`` interpolation) rather than bench-local
+    stopwatches — the regression number is provably the same series
+    operators alert on. The client-side measurement rides along in
+    ``extra`` for cross-checking bucket-resolution error."""
+    import urllib.request
+
     import jax.numpy as jnp
 
-    from keystone_tpu.gateway import Gateway
-
+    from keystone_tpu.gateway import Gateway, GatewayServer
     from keystone_tpu.gateway.admission import Overloaded
+    from keystone_tpu.observability.prometheus import (
+        histogram_buckets,
+        quantile_from_buckets,
+    )
 
     rng = np.random.default_rng(4)
     examples = rng.standard_normal((n_requests, d)).astype(np.float32)
@@ -264,16 +279,40 @@ def bench_gateway(
                     "keystone_gateway_shed_total"
                 ))
             )
+        # the regression number comes off the wire: scrape /metrics
+        # exactly like an operator's Prometheus would and compute the
+        # quantile from the exported le buckets
+        with GatewayServer(gw, port=0, registry=m.registry) as srv:
+            with urllib.request.urlopen(
+                srv.url("/metrics"), timeout=15
+            ) as resp:
+                exposition = resp.read().decode("utf-8")
+        buckets_scraped = histogram_buckets(
+            exposition,
+            "keystone_gateway_request_latency_seconds",
+            {"gateway": gw.name},
+        )
+        p99_s = quantile_from_buckets(0.99, buckets_scraped)
+        if p99_s is None:
+            raise RuntimeError(
+                "gateway bench: /metrics had no latency buckets:\n"
+                + exposition
+            )
         emit(
             "serving_gateway_p99",
-            float(np.percentile(latencies, 99)) * 1e3, "ms",
+            p99_s * 1e3, "ms",
             extra={
+                "source": "scraped /metrics histogram_quantile",
                 "requests": n_requests,
                 "served": len(latencies),
                 "client_threads": n_threads,
                 "lanes": n_lanes,
+                "client_p99_ms": round(
+                    float(np.percentile(latencies, 99)) * 1e3, 3
+                ),
                 "p50_ms": round(
-                    float(np.percentile(latencies, 50)) * 1e3, 3
+                    (quantile_from_buckets(0.5, buckets_scraped) or 0)
+                    * 1e3, 3
                 ),
                 "requests_per_sec": round(len(latencies) / dt, 1),
                 "shed": int(m.outcome_count("shed")),

@@ -170,3 +170,107 @@ def test_lane_error_counts_as_error_outcome():
 def test_max_pending_validation():
     with pytest.raises(ValueError):
         make_admission(FakePool(), max_pending=0)
+
+
+# -- SLO-pressure early shed -----------------------------------------------
+
+
+def test_slo_pressure_sheds_before_queue_full():
+    """The burn watchdog's lever: under pressure the EFFECTIVE queue
+    bound shrinks, arrivals past it shed with the dedicated
+    slo_pressure reason, and the hard queue_full bound still owns the
+    truly-full case."""
+    pool = FakePool(capacity=0)
+    adm, metrics = make_admission(pool, max_pending=8)
+    try:
+        adm.set_pressure(0.75)  # effective bound: 8 * 0.25 = 2
+        assert adm.effective_max_pending == 2
+        adm.submit("a")
+        adm.submit("b")
+        with pytest.raises(Overloaded) as e:
+            adm.submit("c")
+        assert e.value.reason == "slo_pressure"
+        assert metrics.shed_count("slo_pressure") == 1
+        assert metrics.outcome_count("shed") == 1
+        # releasing the pressure restores the full bound immediately
+        adm.set_pressure(0.0)
+        assert adm.effective_max_pending == 8
+        adm.submit("c")
+        assert adm.queue_depth == 3
+    finally:
+        pool.open_capacity()
+        adm.close()
+        pool.resolve_all()
+
+
+def test_pressure_clamped_and_never_below_one_slot():
+    pool = FakePool(capacity=0)
+    adm, _ = make_admission(pool, max_pending=4)
+    try:
+        adm.set_pressure(99.0)  # clamped to 1.0
+        assert adm.pressure == 1.0
+        assert adm.effective_max_pending == 1  # never zero
+        adm.set_pressure(-3.0)
+        assert adm.pressure == 0.0
+        assert adm.effective_max_pending == 4
+    finally:
+        pool.open_capacity()
+        adm.close()
+
+
+def test_trace_id_rides_the_returned_future():
+    from keystone_tpu.observability.tracing import (
+        disable_tracing,
+        enable_tracing,
+    )
+
+    pool = FakePool(capacity=0)
+    adm, _ = make_admission(pool, max_pending=4)
+    tracer = enable_tracing()
+    try:
+        fut = adm.submit("a")
+        assert isinstance(fut.trace_id, str) and len(fut.trace_id) == 32
+    finally:
+        disable_tracing()
+        tracer.clear()
+        pool.open_capacity()
+        adm.close()
+        pool.resolve_all()
+
+
+def test_finish_feeds_flight_recorder_on_error():
+    """An errored request is tail-sampled no matter how fast it was."""
+    from keystone_tpu.observability.flight import FlightRecorder
+    from keystone_tpu.observability.tracing import Tracer
+
+    pool = FakePool(capacity=1_000_000)
+    flight = FlightRecorder(
+        tracer=Tracer(), latency_threshold_s=1e9,
+        registry=MetricsRegistry(),
+    )
+    metrics = GatewayMetrics(
+        registry=MetricsRegistry(), gateway="flight-gw"
+    )
+    adm = AdmissionController(
+        pool, max_pending=4, metrics=metrics, name="flight-gw",
+        flight=flight, forensic_threshold_s=1e9,
+    )
+    try:
+        fut = adm.submit("a")
+        # resolve the lane future with an error -> _finish captures
+        deadline = time.perf_counter() + 5
+        while not pool.submitted and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        pool.submitted[0][1].set_exception(RuntimeError("lane died"))
+        with pytest.raises(RuntimeError):
+            fut.result(timeout=5)
+        deadline = time.perf_counter() + 5
+        while not flight.records() and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        (record,) = flight.records()
+        assert record.reason == "error"
+        assert record.attrs["gateway"] == "flight-gw"
+        assert "lane died" in record.attrs["error"]
+    finally:
+        adm.close()
+        pool.resolve_all()

@@ -1,0 +1,312 @@
+"""The end-to-end forensic chain (ISSUE 4 acceptance): one injected
+over-SLO request (a) raises the fast-window burn gauge and flips
+admission to early-shed, (b) is captured with its full span tree at
+/debugz and round-trips to a valid Chrome trace, (c) appears as an
+exemplar trace_id on the latency histogram at /metrics, and (d) its
+spans arrive at a stub in-process OTLP collector — stdlib only."""
+
+import itertools
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from keystone_tpu.gateway import Gateway, GatewayServer, Overloaded
+from keystone_tpu.observability import (
+    OtlpSpanExporter,
+    disable_tracing,
+    enable_tracing,
+    get_tracer,
+)
+
+from gateway_fixtures import D, batch, make_fitted
+
+_ids = itertools.count()
+
+
+class StubOtlpCollector:
+    """Minimal in-process OTLP/HTTP collector: records POSTed spans."""
+
+    def __init__(self):
+        self.spans = []
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length))
+                with outer._lock:
+                    for rs in body["resourceSpans"]:
+                        for ss in rs["scopeSpans"]:
+                            outer.spans.extend(ss["spans"])
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        ).start()
+
+    @property
+    def endpoint(self):
+        return f"http://127.0.0.1:{self._httpd.server_address[1]}"
+
+    def snapshot(self):
+        with self._lock:
+            return list(self.spans)
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _get(srv, path, accept=None):
+    req = urllib.request.Request(
+        srv.url(path), headers={"Accept": accept} if accept else {}
+    )
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+@pytest.fixture
+def forensic_plane():
+    """Tracing + OTLP stub + a gateway whose latency SLO is impossible
+    (0.1 ms), so every real request is an injected over-SLO request."""
+    tracer = enable_tracing()
+    tracer.clear()
+    collector = StubOtlpCollector()
+    exporter = OtlpSpanExporter(
+        collector.endpoint, flush_interval_s=0.05
+    ).install(tracer)
+    name = f"forensic-gw{next(_ids)}"
+    gw = Gateway(
+        make_fitted(),
+        buckets=(4, 8),
+        n_lanes=2,
+        max_delay_ms=1.0,
+        warmup_example=np.zeros(D, np.float32),
+        name=name,
+        max_pending=4,
+        slo_latency_s=0.0001,       # unmeetable: everything breaches
+        slo_target=0.5,             # budget 50% -> all-bad burn = 2.0
+        slo_fast_window_s=0.3,
+        slo_slow_window_s=30.0,
+        slo_sample_interval_s=0.05,
+        slo_shed_burn=1.5,
+        slo_sustain_samples=2,
+        slo_pressure=0.75,
+    )
+    srv = GatewayServer(gw, port=0).start()
+    yield gw, srv, collector, exporter
+    gw.close()
+    srv.stop()
+    exporter.shutdown()
+    collector.close()
+    disable_tracing()
+    tracer.clear()
+
+
+def test_forensic_chain_end_to_end(forensic_plane):
+    gw, srv, collector, exporter = forensic_plane
+    xs = batch(4, seed=7)
+
+    # --- drive traffic; every request breaches the 0.1 ms SLO ---------
+    for i in range(4):
+        gw.predict(xs[i]).result(timeout=30)
+
+    # --- (a) burn gauge rises and admission flips to early-shed -------
+    deadline = time.perf_counter() + 15
+    while (
+        gw.admission.pressure == 0.0 and time.perf_counter() < deadline
+    ):
+        time.sleep(0.02)
+    assert gw.admission.pressure == 0.75, (
+        "SLO watchdog never tightened admission; slz="
+        + json.dumps(gw.slo_monitor.status())
+    )
+    assert gw.admission.effective_max_pending == 1  # 4 * (1 - 0.75)
+    burns = gw.slo_monitor.burn_rates(f"{gw.name}:latency")
+    assert burns["fast"] is not None and burns["fast"] >= 1.5
+    # the burn gauge is on the scrape surface
+    _, metrics_body = _get(srv, "/metrics")
+    assert "keystone_slo_burn_rate" in metrics_body
+    assert f'slo="{gw.name}:latency",window="fast"' in metrics_body
+    assert (
+        f'keystone_gateway_slo_pressure{{gateway="{gw.name}"}} 0.75'
+        in metrics_body
+    )
+    # /readyz stays 200 but surfaces the burn state
+    status, ready_body = _get(srv, "/readyz")
+    assert status == 200
+    assert "slo burning" in ready_body
+
+    # early shed demonstrably fires before the hard queue bound: burst
+    # submits faster than the lanes drain until one sheds
+    shed_reason = None
+    pending = []
+    deadline = time.perf_counter() + 15
+    while shed_reason is None and time.perf_counter() < deadline:
+        try:
+            for i in range(32):
+                pending.append(gw.predict(xs[i % 4]))
+        except Overloaded as e:
+            shed_reason = e.reason
+    for f in pending:
+        try:
+            f.result(timeout=30)
+        except Exception:
+            pass
+    assert shed_reason == "slo_pressure", shed_reason
+    assert gw.metrics.shed_count("slo_pressure") >= 1
+
+    # --- (b) flight recorder captured the span tree at /debugz --------
+    records = gw.flight.records()
+    assert records, "no flight records despite guaranteed breaches"
+    record = records[0]
+    assert record.reason == "slo_breach"
+    assert record.attrs["gateway"] == gw.name
+    assert record.attrs["lane"] in (0, 1)
+    span_names = {s.name for s in record.spans}
+    assert "gateway.admit" in span_names
+    assert "microbatch.coalesce" in span_names
+    assert "serving.dispatch" in span_names
+    trace_id = record.trace_id
+    _, debugz = _get(srv, "/debugz")
+    doc = json.loads(debugz)
+    assert any(r["trace_id"] == trace_id for r in doc["records"])
+    # Chrome round-trip for exactly this request
+    _, chrome = _get(
+        srv, f"/debugz?trace_id={trace_id}&format=chrome"
+    )
+    chrome_doc = json.loads(chrome)
+    events = chrome_doc["traceEvents"]
+    assert {e["name"] for e in events if e["ph"] == "X"} == span_names
+    assert all(
+        e["args"]["trace_id"] == trace_id
+        for e in events if e["ph"] == "X"
+    )
+
+    # --- (c) the trace id is an exemplar on the latency histogram -----
+    # exemplars only travel in the OpenMetrics rendering (the classic
+    # text parser would reject the mid-line '#'), negotiated by Accept
+    _, metrics_body = _get(
+        srv, "/metrics", accept="application/openmetrics-text"
+    )
+    assert metrics_body.endswith("# EOF\n")
+    # a plain scrape of the same surface stays classic and exemplar-free
+    _, plain_body = _get(srv, "/metrics")
+    assert "# {" not in plain_body
+    exemplar_lines = [
+        ln for ln in metrics_body.splitlines()
+        if ln.startswith(
+            f'keystone_gateway_request_latency_seconds_bucket'
+            f'{{gateway="{gw.name}"'
+        ) and " # {" in ln
+    ]
+    assert exemplar_lines, "latency histogram carries no exemplars"
+    exemplified = {
+        ln.split('trace_id="')[1].split('"')[0] for ln in exemplar_lines
+    }
+    captured = {r.trace_id for r in gw.flight.records()}
+    assert exemplified & captured, (
+        "no exemplar trace_id matches a flight record"
+    )
+
+    # --- (d) the spans arrived at the OTLP collector ------------------
+    assert exporter.flush(10.0)
+    otlp_spans = collector.snapshot()
+    ours = [s for s in otlp_spans if s["traceId"] == trace_id]
+    assert {s["name"] for s in ours} >= {
+        "gateway.admit", "microbatch.coalesce", "serving.dispatch",
+    }
+    # /slz shows both objectives of this gateway
+    _, slz = _get(srv, "/slz")
+    slz_names = {s["name"] for s in json.loads(slz)["slos"]}
+    assert f"{gw.name}:latency" in slz_names
+    assert f"{gw.name}:availability" in slz_names
+
+
+def test_watchdog_requires_consecutive_hot_samples():
+    """'Sustained' means CONSECUTIVE over-threshold burn samples: a
+    cooler sample in between resets the streak, so two isolated spikes
+    (possibly hours apart) never trip admission tightening."""
+
+    class _StubMonitor:
+        fast = 0.0
+
+        def burn_rates(self, name):
+            return {"fast": self.fast, "slow": None}
+
+    gw = Gateway(
+        make_fitted(),
+        buckets=(4,),
+        n_lanes=1,
+        warmup_example=np.zeros(D, np.float32),
+        name=f"streak-gw{next(_ids)}",
+        slo_latency_s=0.25,
+        slo_shed_burn=4.0,
+        slo_sustain_samples=2,
+        slo_sample_interval_s=3600.0,  # the real monitor stays quiet
+    )
+    try:
+        mon = _StubMonitor()
+        # spike, cool-but-burning (>=1), spike again: streak broken
+        for fast in (4.5, 2.0, 4.2):
+            mon.fast = fast
+            gw._slo_watchdog(mon)
+        assert gw.admission.pressure == 0.0, (
+            "non-consecutive spikes must not tighten admission"
+        )
+        # two consecutive spikes DO trip it
+        for fast in (4.5, 4.2):
+            mon.fast = fast
+            gw._slo_watchdog(mon)
+        assert gw.admission.pressure == 0.75
+        # moderate burn (>= 1) holds the pressure; sub-1 releases it
+        mon.fast = 2.0
+        gw._slo_watchdog(mon)
+        assert gw.admission.pressure == 0.75
+        mon.fast = 0.5
+        gw._slo_watchdog(mon)
+        assert gw.admission.pressure == 0.0
+    finally:
+        gw.close()
+
+
+def test_slo_plane_off_by_default():
+    """No SLO declared -> no monitor, no flight recorder, no pressure
+    path, no exemplars: the whole forensic plane is zero-overhead."""
+    gw = Gateway(
+        make_fitted(),
+        buckets=(4,),
+        n_lanes=1,
+        warmup_example=np.zeros(D, np.float32),
+        name=f"plain-gw{next(_ids)}",
+    )
+    try:
+        assert gw.slo_monitor is None
+        assert gw.flight is None
+        assert gw.slo_status() is None
+        assert gw.admission.flight is None
+        assert gw.admission.pressure == 0.0
+        gw.predict(batch(1, seed=3)[0]).result(timeout=30)
+        fam = gw.metrics.request_latency.collect()
+        # the family is shared process-wide; only THIS gateway's cells
+        # are guaranteed exemplar-free (untraced requests carry no ids)
+        assert all(
+            s.exemplar is None
+            for s in fam.samples
+            if s.labels.get("gateway") == gw.name
+        )
+    finally:
+        gw.close()

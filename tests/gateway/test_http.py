@@ -188,3 +188,84 @@ def test_bad_deadline_ms_is_400(served):
                 "instances": [batch(1)[0].tolist()], "deadline_ms": bad,
             })
         assert e.value.code == 400, f"deadline_ms={bad!r}"
+
+
+def test_request_log_emits_structured_json_lines(capsys):
+    """--request-log: one JSON line per /predict instance on stdout
+    with status, latency_ms, lane, and the trace id that keys the
+    flight recorder — greppable forensics from the process log."""
+    tracer = enable_tracing()
+    tracer.clear()
+    gw = Gateway(
+        make_fitted(), buckets=(4,), n_lanes=2, max_delay_ms=2.0,
+        warmup_example=np.zeros(D, np.float32),
+        name=f"http-log-gw{next(_gw_ids)}",
+    )
+    srv = GatewayServer(gw, port=0, request_log=True).start()
+    try:
+        xs = batch(2, seed=54)
+        status, _ = _post(srv, "/predict", {"instances": xs.tolist()})
+        assert status == 200
+        lines = [
+            json.loads(ln)
+            for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith('{"ts"')
+        ]
+        assert len(lines) == 2
+        for line in lines:
+            assert line["path"] == "/predict"
+            assert line["status"] == 200
+            assert line["latency_ms"] > 0
+            assert line["lane"] in (0, 1)
+            assert (
+                isinstance(line["trace_id"], str)
+                and len(line["trace_id"]) == 32
+            )
+        # the logged trace ids are real: the tracer knows their spans
+        for line in lines:
+            spans = get_tracer().spans_for_trace(line["trace_id"])
+            assert any(s.name == "gateway.admit" for s in spans)
+        # error path logs too (draining -> 503 closed)
+        gw.close()
+        with pytest.raises(urllib.error.HTTPError):
+            _post(srv, "/predict", {"instances": xs.tolist()})
+        err_lines = [
+            json.loads(ln)
+            for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith('{"ts"')
+        ]
+        assert any(
+            ln["status"] == 503 and ln["error"] == "closed"
+            for ln in err_lines
+        )
+    finally:
+        gw.close()
+        srv.stop()
+        disable_tracing()
+        tracer.clear()
+
+
+def test_request_log_off_by_default(served, capsys):
+    _, _, srv = served
+    assert srv.request_log is False
+    _post(srv, "/predict", {"instances": batch(1, seed=55).tolist()})
+    out = capsys.readouterr().out
+    assert '"path": "/predict"' not in out
+
+
+def test_gateway_serves_slz_and_debugz(served):
+    """Single-port deployments get the forensic surfaces from the
+    gateway frontend itself (mirroring the admin endpoint)."""
+    _, _, srv = served
+    _, slz = _get(srv, "/slz")
+    assert "slos" in json.loads(slz)
+    _, debugz = _get(srv, "/debugz")
+    assert "records" in json.loads(debugz)
+    # error parity with the admin endpoint (same shared routing):
+    # chrome format without a trace id is a 400, unknown trace a 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(srv, "/debugz?format=chrome")
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(srv, "/debugz?trace_id=deadbeef&format=chrome")
+    assert e.value.code == 404

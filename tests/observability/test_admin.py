@@ -52,6 +52,30 @@ def test_metrics_scrape_content_type_and_body():
     assert 'hits_total{path="/x"} 3' in body
 
 
+def test_metrics_negotiates_openmetrics_for_exemplars():
+    """A scraper sending the OpenMetrics Accept header (a real
+    Prometheus server does by default) gets exemplar tails + # EOF;
+    a plain scrape of the same registry stays classic v0.0.4 text
+    with no mid-line '#' to trip the old parser."""
+    reg = MetricsRegistry()
+    reg.histogram("lat_s", "l", buckets=(1.0,)).observe(
+        0.5, trace_id="tid42"
+    )
+    with AdminServer(registry=reg, tracer=Tracer()) as srv:
+        req = urllib.request.Request(
+            srv.url("/metrics"),
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            om_ctype = resp.headers["Content-Type"]
+            om_body = resp.read().decode("utf-8")
+        _, _, plain_body = _get(srv, "/metrics")
+    assert om_ctype.startswith("application/openmetrics-text")
+    assert '# {trace_id="tid42"}' in om_body
+    assert om_body.endswith("# EOF\n")
+    assert "# {" not in plain_body
+
+
 def test_varz_json():
     reg = MetricsRegistry()
     reg.gauge("depth").set(2)
@@ -191,3 +215,84 @@ def test_disabled_admin_means_no_server_and_no_spans():
     with tracer.span("ghost"):
         pass
     assert len(tracer.recent()) == before
+
+
+def test_varz_build_info_block():
+    reg = MetricsRegistry()
+    with AdminServer(registry=reg, tracer=Tracer()) as srv:
+        _, _, body = _get(srv, "/varz")
+        _, _, metrics = _get(srv, "/metrics")
+    build = json.loads(body)["build"]
+    for key in (
+        "git_sha", "start_time_unix_s", "uptime_s", "pid",
+        "python_version", "jax_version", "device_kind",
+    ):
+        assert key in build, f"missing {key} in build block: {build}"
+    assert build["uptime_s"] >= 0
+    # identity also on the scrape surface: constant info gauge +
+    # standard process start time
+    assert "# TYPE keystone_build_info gauge" in metrics
+    assert 'keystone_build_info{git_sha="' in metrics
+    assert "keystone_process_start_time_seconds" in metrics
+
+
+def test_slz_endpoint_renders_monitors():
+    from keystone_tpu.observability.slo import Slo, SloMonitor
+
+    reg = MetricsRegistry()
+    mon = SloMonitor(
+        fast_window_s=10, slow_window_s=100, registry=reg
+    )
+    state = {"total": 0.0, "bad": 0.0}
+    mon.add(
+        Slo(
+            "adminz:api", 0.99,
+            lambda: (state["total"], state["bad"]),
+        )
+    )
+    mon.sample(now=0.0)
+    state["total"], state["bad"] = 10.0, 1.0  # 10% bad in-window
+    mon.sample(now=10.0)
+    with AdminServer(registry=reg, tracer=Tracer()) as srv:
+        _, headers, body = _get(srv, "/slz")
+    assert headers["Content-Type"].startswith("application/json")
+    doc = json.loads(body)
+    (entry,) = [
+        s for s in doc["slos"] if s["name"] == "adminz:api"
+    ]
+    assert entry["burn_rate"]["fast"] == pytest.approx(10.0)  # 10%/1%
+    assert entry["breaching"] is True
+
+
+def test_debugz_endpoint_lists_and_dumps_records(traced):
+    from keystone_tpu.observability.flight import FlightRecorder
+
+    reg = MetricsRegistry()
+    rec = FlightRecorder(
+        tracer=traced, latency_threshold_s=0.05, registry=reg
+    )
+    with traced.span("gateway.admit") as admit:
+        with traced.span("serving.dispatch"):
+            pass
+    rec.maybe_capture(admit.trace_id, duration_s=0.2, gateway="gw-a")
+    with AdminServer(registry=reg, tracer=traced) as srv:
+        _, _, body = _get(srv, "/debugz")
+        _, _, one = _get(srv, f"/debugz?trace_id={admit.trace_id}")
+        _, _, chrome = _get(
+            srv, f"/debugz?trace_id={admit.trace_id}&format=chrome"
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv, "/debugz?trace_id=deadbeef&format=chrome")
+    assert e.value.code == 404
+    doc = json.loads(body)
+    assert doc["recorders"] >= 1
+    assert any(r["trace_id"] == admit.trace_id for r in doc["records"])
+    (record,) = json.loads(one)["records"]
+    assert record["reason"] == "slo_breach"
+    assert {s["name"] for s in record["spans"]} == {
+        "gateway.admit", "serving.dispatch",
+    }
+    chrome_doc = json.loads(chrome)
+    assert {e["name"] for e in chrome_doc["traceEvents"]} >= {
+        "gateway.admit", "serving.dispatch",
+    }

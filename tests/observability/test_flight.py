@@ -1,0 +1,178 @@
+"""Flight recorder: tail-sampling decisions, span-tree capture by
+trace id, bounded forensic ring, Chrome-trace round-trip, /debugz
+rendering, and the disabled fast path."""
+
+import json
+
+from keystone_tpu.observability.flight import (
+    FlightRecorder,
+    debugz_status,
+    find_record,
+)
+from keystone_tpu.observability.registry import MetricsRegistry
+from keystone_tpu.observability.tracing import Tracer
+
+
+def traced_request(tracer, slow=False):
+    """One request-shaped span tree; returns its trace id."""
+    with tracer.span("gateway.admit", gateway="t") as admit:
+        with tracer.span("microbatch.coalesce", window=1):
+            with tracer.span("serving.dispatch", bucket=4):
+                pass
+    return admit.trace_id
+
+
+def make_recorder(tracer, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    return FlightRecorder(tracer=tracer, **kw)
+
+
+class TestTailSampling:
+    def test_breach_captures_full_span_tree(self):
+        tr = Tracer()
+        rec = make_recorder(tr, latency_threshold_s=0.1)
+        trace_id = traced_request(tr)
+        record = rec.maybe_capture(trace_id, duration_s=0.5)
+        assert record is not None and record.reason == "slo_breach"
+        assert record.trace_id == trace_id
+        names = {s.name for s in record.spans}
+        assert names == {
+            "gateway.admit", "microbatch.coalesce", "serving.dispatch",
+        }
+        # parent links intact inside the captured tree
+        by_name = {s.name: s for s in record.spans}
+        assert (
+            by_name["serving.dispatch"].parent_id
+            == by_name["microbatch.coalesce"].span_id
+        )
+
+    def test_fast_request_not_captured(self):
+        tr = Tracer()
+        rec = make_recorder(tr, latency_threshold_s=0.1)
+        trace_id = traced_request(tr)
+        assert rec.maybe_capture(trace_id, duration_s=0.01) is None
+        assert rec.records() == []
+
+    def test_error_captures_regardless_of_latency(self):
+        tr = Tracer()
+        rec = make_recorder(tr, latency_threshold_s=0.1)
+        trace_id = traced_request(tr)
+        record = rec.maybe_capture(
+            trace_id, duration_s=0.001,
+            error=RuntimeError("lane exploded"),
+        )
+        assert record.reason == "error"
+        assert "lane exploded" in record.attrs["error"]
+
+    def test_per_call_threshold_overrides_default(self):
+        tr = Tracer()
+        rec = make_recorder(tr, latency_threshold_s=10.0)
+        trace_id = traced_request(tr)
+        record = rec.maybe_capture(
+            trace_id, duration_s=0.2, threshold_s=0.1
+        )
+        assert record is not None
+        assert record.attrs["threshold_ms"] == 100.0
+
+    def test_no_threshold_no_latency_capture(self):
+        tr = Tracer()
+        rec = make_recorder(tr)  # no threshold configured anywhere
+        trace_id = traced_request(tr)
+        assert rec.maybe_capture(trace_id, duration_s=100.0) is None
+
+    def test_disabled_recorder_captures_nothing(self):
+        tr = Tracer()
+        rec = make_recorder(tr, latency_threshold_s=0.0, enabled=False)
+        trace_id = traced_request(tr)
+        assert rec.maybe_capture(trace_id, duration_s=1.0) is None
+        assert rec.records() == []
+
+    def test_extra_attrs_ride_along(self):
+        tr = Tracer()
+        rec = make_recorder(tr, latency_threshold_s=0.1)
+        trace_id = traced_request(tr)
+        record = rec.maybe_capture(
+            trace_id, duration_s=0.5, gateway="gw0", lane=1
+        )
+        assert record.attrs["gateway"] == "gw0"
+        assert record.attrs["lane"] == 1
+
+    def test_capture_counter_by_reason(self):
+        tr = Tracer()
+        reg = MetricsRegistry()
+        rec = FlightRecorder(
+            tracer=tr, latency_threshold_s=0.1, registry=reg
+        )
+        rec.maybe_capture(traced_request(tr), duration_s=0.5)
+        rec.maybe_capture(
+            traced_request(tr), duration_s=0.0, error=ValueError("x")
+        )
+        c = reg.counter(
+            "keystone_flight_records_total", "", ("reason",)
+        )
+        assert c.get(("slo_breach",)) == 1
+        assert c.get(("error",)) == 1
+
+
+class TestRingAndQueries:
+    def test_ring_is_bounded(self):
+        tr = Tracer()
+        rec = make_recorder(tr, capacity=3, latency_threshold_s=0.0)
+        ids = [traced_request(tr) for _ in range(6)]
+        for tid in ids:
+            rec.maybe_capture(tid, duration_s=1.0)
+        kept = [r.trace_id for r in rec.records()]
+        assert kept == ids[-3:]  # oldest evicted, order preserved
+
+    def test_find_and_clear(self):
+        tr = Tracer()
+        rec = make_recorder(tr, latency_threshold_s=0.0)
+        tid = traced_request(tr)
+        rec.maybe_capture(tid, duration_s=1.0)
+        assert rec.find(tid).trace_id == tid
+        assert rec.find("nope") is None
+        rec.clear()
+        assert rec.records() == []
+
+    def test_module_level_debugz_view(self):
+        tr = Tracer()
+        rec = make_recorder(tr, latency_threshold_s=0.0)
+        tid = traced_request(tr)
+        rec.maybe_capture(tid, duration_s=1.0, gateway="gw-z")
+        doc = debugz_status()
+        assert any(r["trace_id"] == tid for r in doc["records"])
+        # filtered view
+        doc = debugz_status(trace_id=tid)
+        assert [r["trace_id"] for r in doc["records"]] == [tid]
+        assert find_record(tid) is not None
+
+    def test_record_to_dict_is_json_able(self):
+        tr = Tracer()
+        rec = make_recorder(tr, latency_threshold_s=0.0)
+        rec.maybe_capture(traced_request(tr), duration_s=0.25)
+        (record,) = rec.records()
+        doc = json.loads(json.dumps(record.to_dict()))
+        assert doc["reason"] == "slo_breach"
+        assert doc["duration_ms"] == 250.0
+        assert len(doc["spans"]) == 3
+        assert all(s["trace_id"] == doc["trace_id"] for s in doc["spans"])
+
+
+class TestChromeTrace:
+    def test_record_round_trips_to_chrome_trace(self):
+        tr = Tracer()
+        rec = make_recorder(tr, latency_threshold_s=0.0)
+        tid = traced_request(tr)
+        record = rec.maybe_capture(tid, duration_s=1.0)
+        doc = json.loads(json.dumps(record.to_chrome_trace()))
+        assert set(doc) == {"traceEvents", "displayTimeUnit"}
+        events = doc["traceEvents"]
+        span_events = [e for e in events if e["ph"] == "X"]
+        assert len(span_events) == 3
+        for e in span_events:
+            assert e["args"]["trace_id"] == tid
+            assert isinstance(e["ts"], float)
+            assert isinstance(e["dur"], float)
+        # the capture verdict rides as an instant event
+        (marker,) = [e for e in events if e["ph"] == "i"]
+        assert marker["name"] == "flight:slo_breach"

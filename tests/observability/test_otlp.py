@@ -1,0 +1,242 @@
+"""OTLP/HTTP span export: wire-format mapping, background batching
+against an in-process stub collector, drop-not-block behavior, and the
+tracer-sink lifecycle."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from keystone_tpu.observability.otlp import (
+    OtlpSpanExporter,
+    encode_spans,
+    format_span_id,
+    span_to_otlp,
+)
+from keystone_tpu.observability.registry import MetricsRegistry
+from keystone_tpu.observability.tracing import Span, Tracer
+
+
+class StubCollector:
+    """A stdlib OTLP collector double: records every POSTed body."""
+
+    def __init__(self, status=200):
+        self.bodies = []
+        self.paths = []
+        self._got = threading.Event()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                outer.bodies.append(
+                    json.loads(self.rfile.read(length))
+                )
+                outer.paths.append(self.path)
+                outer._got.set()
+                self.send_response(status)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def endpoint(self):
+        return f"http://127.0.0.1:{self._httpd.server_address[1]}"
+
+    def wait(self, timeout=5.0):
+        return self._got.wait(timeout)
+
+    def spans(self):
+        out = []
+        for body in self.bodies:
+            for rs in body["resourceSpans"]:
+                for ss in rs["scopeSpans"]:
+                    out.extend(ss["spans"])
+        return out
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+@pytest.fixture
+def collector():
+    c = StubCollector()
+    yield c
+    c.close()
+
+
+def make_span(name="work", span_id=7, parent_id=None, trace_id="ab" * 16,
+              attrs=None):
+    return Span(
+        name=name, span_id=span_id, parent_id=parent_id,
+        start_s=1700000000.0, duration_s=0.125, thread_id=1,
+        attrs=attrs if attrs is not None else {"bucket": 8},
+        trace_id=trace_id,
+    )
+
+
+class TestWireFormat:
+    def test_span_mapping(self):
+        otlp = span_to_otlp(make_span(parent_id=3))
+        assert otlp["traceId"] == "ab" * 16
+        assert otlp["spanId"] == format_span_id(7)
+        assert otlp["parentSpanId"] == format_span_id(3)
+        assert otlp["name"] == "work"
+        assert otlp["startTimeUnixNano"] == str(1700000000 * 10**9)
+        assert (
+            int(otlp["endTimeUnixNano"])
+            - int(otlp["startTimeUnixNano"])
+        ) == 125_000_000
+        # int attrs serialize as strings (proto3 JSON int64 rule)
+        attrs = {a["key"]: a["value"] for a in otlp["attributes"]}
+        assert attrs["bucket"] == {"intValue": "8"}
+        assert attrs["thread.id"] == {"intValue": "1"}
+
+    def test_root_span_has_no_parent_field(self):
+        assert "parentSpanId" not in span_to_otlp(make_span())
+
+    def test_span_id_is_16_hex_chars(self):
+        assert format_span_id(1) == "0000000000000001"
+        assert len(format_span_id(2**70)) == 16
+
+    def test_attr_value_types(self):
+        otlp = span_to_otlp(
+            make_span(attrs={
+                "f": 0.5, "b": True, "s": "x", "o": [1, 2],
+            })
+        )
+        attrs = {a["key"]: a["value"] for a in otlp["attributes"]}
+        assert attrs["f"] == {"doubleValue": 0.5}
+        assert attrs["b"] == {"boolValue": True}
+        assert attrs["s"] == {"stringValue": "x"}
+        assert attrs["o"] == {"stringValue": "[1, 2]"}
+
+    def test_orphan_trace_id_is_nonzero(self):
+        otlp = span_to_otlp(make_span(trace_id=None))
+        assert otlp["traceId"] == "f" * 32
+
+    def test_encode_spans_envelope(self):
+        body = encode_spans([make_span()], service_name="svc-x")
+        (rs,) = body["resourceSpans"]
+        res_attrs = {
+            a["key"]: a["value"] for a in rs["resource"]["attributes"]
+        }
+        assert res_attrs["service.name"] == {"stringValue": "svc-x"}
+        (ss,) = rs["scopeSpans"]
+        assert len(ss["spans"]) == 1
+
+
+class TestExporter:
+    def test_posts_batches_to_v1_traces(self, collector):
+        exp = OtlpSpanExporter(
+            collector.endpoint, flush_interval_s=0.05,
+            registry=MetricsRegistry(),
+        )
+        exp.start()
+        try:
+            exp.submit(make_span(span_id=1))
+            exp.submit(make_span(span_id=2))
+            assert exp.flush(5.0)
+            assert collector.wait()
+        finally:
+            exp.shutdown()
+        assert all(p == "/v1/traces" for p in collector.paths)
+        ids = {s["spanId"] for s in collector.spans()}
+        assert ids == {format_span_id(1), format_span_id(2)}
+
+    def test_endpoint_path_appended_once(self):
+        reg = MetricsRegistry()
+        exp = OtlpSpanExporter("http://x:4318", registry=reg)
+        assert exp.endpoint == "http://x:4318/v1/traces"
+        exp2 = OtlpSpanExporter(
+            "http://x:4318/v1/traces", registry=reg
+        )
+        assert exp2.endpoint == "http://x:4318/v1/traces"
+
+    def test_installed_as_tracer_sink_exports_finished_spans(
+        self, collector
+    ):
+        tr = Tracer()
+        exp = OtlpSpanExporter(
+            collector.endpoint, flush_interval_s=0.05,
+            registry=MetricsRegistry(),
+        )
+        exp.install(tr)
+        try:
+            with tr.span("outer", gateway="g") as outer:
+                with tr.span("inner"):
+                    pass
+            assert exp.flush(5.0)
+            assert collector.wait()
+        finally:
+            exp.shutdown()
+        spans = {s["name"]: s for s in collector.spans()}
+        assert set(spans) == {"outer", "inner"}
+        assert spans["inner"]["traceId"] == outer.trace_id
+        assert spans["inner"]["parentSpanId"] == format_span_id(
+            outer.span_id
+        )
+        # shutdown unhooked the sink: new spans no longer enqueue
+        with tr.span("after"):
+            pass
+        assert len(tr._sinks) == 0
+
+    def test_full_queue_drops_oldest_not_blocks(self):
+        reg = MetricsRegistry()
+        exp = OtlpSpanExporter(
+            "http://127.0.0.1:9",  # nothing listens; never started
+            batch_size=4, queue_capacity=4, registry=reg,
+        )
+        for i in range(10):
+            exp.submit(make_span(span_id=i))
+        assert len(exp._q) == 4
+        dropped = reg.counter(
+            "keystone_otlp_spans_total", "", ("result",)
+        ).get(("dropped",))
+        assert dropped == 6
+
+    def test_dead_collector_counts_errors_and_drops(self):
+        reg = MetricsRegistry()
+        exp = OtlpSpanExporter(
+            "http://127.0.0.1:9", flush_interval_s=0.05,
+            timeout_s=0.5, registry=reg,
+        )
+        exp.start()
+        try:
+            exp.submit(make_span())
+            assert exp.flush(10.0)
+        finally:
+            exp.shutdown()
+        c = reg.counter("keystone_otlp_posts_total", "", ("result",))
+        assert c.get(("error",)) >= 1
+
+    def test_export_health_counters(self, collector):
+        reg = MetricsRegistry()
+        exp = OtlpSpanExporter(
+            collector.endpoint, flush_interval_s=0.05, registry=reg
+        )
+        exp.start()
+        try:
+            exp.submit(make_span())
+            assert exp.flush(5.0)
+        finally:
+            exp.shutdown()
+        spans_c = reg.counter(
+            "keystone_otlp_spans_total", "", ("result",)
+        )
+        posts_c = reg.counter(
+            "keystone_otlp_posts_total", "", ("result",)
+        )
+        assert spans_c.get(("exported",)) == 1
+        assert posts_c.get(("ok",)) == 1

@@ -173,3 +173,157 @@ def test_histogram_family_golden():
         'gw_wait_seconds_count{gateway="g"} 3\n'
         'gw_wait_seconds_sum{gateway="g"} 3.205\n'
     )
+
+
+# -- exemplars (OpenMetrics syntax) ----------------------------------------
+
+
+def test_exemplar_golden_string():
+    from keystone_tpu.observability.registry import Exemplar
+
+    fam = MetricFamily(
+        "lat_seconds", "histogram", "",
+        [
+            Sample(
+                "_bucket", {"le": "0.25"}, 3,
+                exemplar=Exemplar(
+                    {"trace_id": "4bf92f3577b34da6"}, 0.2, 1700000000.5
+                ),
+            ),
+            Sample("_bucket", {"le": "+Inf"}, 3),
+            Sample("_count", {}, 3),
+        ],
+    )
+    assert render_family(fam, exemplars=True) == (
+        "# TYPE lat_seconds histogram\n"
+        'lat_seconds_bucket{le="0.25"} 3'
+        ' # {trace_id="4bf92f3577b34da6"} 0.2 1700000000.5\n'
+        'lat_seconds_bucket{le="+Inf"} 3\n'
+        "lat_seconds_count 3\n"
+    )
+    # the classic v0.0.4 rendering must NEVER carry the exemplar tail:
+    # that parser reads the mid-line '#' as a malformed timestamp and
+    # fails the whole scrape
+    assert render_family(fam) == (
+        "# TYPE lat_seconds histogram\n"
+        'lat_seconds_bucket{le="0.25"} 3\n'
+        'lat_seconds_bucket{le="+Inf"} 3\n'
+        "lat_seconds_count 3\n"
+    )
+
+
+def test_exemplar_rendered_from_live_histogram():
+    reg = MetricsRegistry()
+    h = reg.histogram("hist_x", "", ("gw",), buckets=(0.5,))
+    h.observe(0.25, ("g0",), trace_id="abc123")
+    body = render(reg.collect(), openmetrics=True)
+    assert '# {trace_id="abc123"} 0.25 ' in body
+    assert body.endswith("# EOF\n")
+    # the exemplar rides the 0.5 bucket line specifically, not +Inf
+    lines = {
+        ln.split(" ", 1)[0]: ln for ln in body.splitlines()
+        if ln.startswith("hist_x_bucket")
+    }
+    assert " # {" in lines['hist_x_bucket{gw="g0",le="0.5"}']
+    assert " # {" not in lines['hist_x_bucket{gw="g0",le="+Inf"}']
+    # classic rendering: same counts, no exemplar tails anywhere
+    plain = render(reg.collect())
+    assert 'hist_x_bucket{gw="g0",le="0.5"} 1' in plain
+    assert "# {" not in plain and "# EOF" not in plain
+
+
+def test_negotiate_render_by_accept_header():
+    from keystone_tpu.observability.prometheus import (
+        CONTENT_TYPE,
+        OPENMETRICS_CONTENT_TYPE,
+        negotiate_render,
+    )
+
+    reg = MetricsRegistry()
+    h = reg.histogram("neg_x", "", buckets=(1.0,))
+    h.observe(0.5, trace_id="tid9")
+    # a real Prometheus server's default Accept prefers openmetrics
+    om_accept = (
+        "application/openmetrics-text;version=1.0.0,"
+        "text/plain;version=0.0.4;q=0.5"
+    )
+    body, ctype = negotiate_render(reg.collect(), om_accept)
+    assert ctype == OPENMETRICS_CONTENT_TYPE
+    assert '# {trace_id="tid9"}' in body and body.endswith("# EOF\n")
+    for accept in (None, "", "text/plain", "*/*"):
+        body, ctype = negotiate_render(reg.collect(), accept)
+        assert ctype == CONTENT_TYPE
+        assert "# {" not in body
+
+
+def test_zero_observation_histogram_renders_valid_block():
+    reg = MetricsRegistry()
+    reg.histogram("empty_hist", "nothing yet", ("lane",))
+    body = render(reg.collect())
+    assert "# HELP empty_hist nothing yet\n" in body
+    assert "# TYPE empty_hist histogram\n" in body
+    assert body.endswith("\n")
+    # no sample lines for the silent family
+    assert not any(
+        ln.startswith("empty_hist_") for ln in body.splitlines()
+    )
+
+
+# -- scrape-side parsing (the bench's /metrics reader) ---------------------
+
+
+def test_parse_samples_round_trip_with_exemplars_and_escapes():
+    from keystone_tpu.observability.prometheus import parse_samples
+
+    reg = MetricsRegistry()
+    c = reg.counter("hits_total", "h", ("path",))
+    c.inc(('/x "q"\n',), by=3)
+    h = reg.histogram("lat_s", "", ("gw",), buckets=(0.5,))
+    h.observe(0.1, ("g0",), trace_id="tid1")
+    rows = parse_samples(render(reg.collect(), openmetrics=True))
+    by_name = {}
+    for name, labels, value in rows:
+        by_name.setdefault(name, []).append((labels, value))
+    assert by_name["hits_total"] == [({"path": '/x "q"\n'}, 3.0)]
+    bucket_rows = dict(
+        (labels["le"], value)
+        for labels, value in by_name["lat_s_bucket"]
+    )
+    # the exemplar tail must NOT corrupt the parsed value
+    assert bucket_rows == {"0.5": 1.0, "+Inf": 1.0}
+
+
+def test_histogram_buckets_filters_by_labels():
+    from keystone_tpu.observability.prometheus import histogram_buckets
+
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_s2", "", ("gw",), buckets=(0.1, 1.0))
+    h.observe(0.05, ("a",))
+    h.observe(0.5, ("a",))
+    h.observe(99.0, ("b",))
+    body = render(reg.collect())
+    got = histogram_buckets(body, "lat_s2", {"gw": "a"})
+    assert got == [(0.1, 1.0), (1.0, 2.0), (float("inf"), 2.0)]
+    assert histogram_buckets(body, "lat_s2", {"gw": "zzz"}) == []
+
+
+def test_quantile_from_buckets_matches_promql_interpolation():
+    from keystone_tpu.observability.prometheus import (
+        quantile_from_buckets,
+    )
+
+    # 10 observations <= 1.0, 10 more in (1.0, 2.0]
+    buckets = [(1.0, 10.0), (2.0, 20.0), (float("inf"), 20.0)]
+    # p50 rank = 10 -> exactly the 1.0 bound
+    assert quantile_from_buckets(0.5, buckets) == 1.0
+    # p75 rank = 15 -> halfway through the (1.0, 2.0] bucket
+    assert quantile_from_buckets(0.75, buckets) == 1.5
+    # p0..first bucket interpolates from lower bound 0
+    assert quantile_from_buckets(0.25, buckets) == 0.5
+    # quantile in +Inf clamps to the highest finite bound
+    assert quantile_from_buckets(
+        0.99, [(1.0, 1.0), (float("inf"), 10.0)]
+    ) == 1.0
+    # empty / zero-count
+    assert quantile_from_buckets(0.5, []) is None
+    assert quantile_from_buckets(0.5, [(1.0, 0.0)]) is None

@@ -238,3 +238,87 @@ def test_histogram_rejects_explicit_inf_bound():
     reg = MetricsRegistry()
     with pytest.raises(ValueError):
         reg.histogram("inf_seconds", buckets=(1.0, float("inf")))
+
+
+# -- RegistryHistogram edge cases ------------------------------------------
+
+
+class TestHistogramEdges:
+    def test_observation_exactly_on_le_boundary_counts_le(self):
+        """Prometheus `le` is INCLUSIVE: an observation equal to a
+        bound belongs to that bound's bucket, not the next one up."""
+        reg = MetricsRegistry()
+        h = reg.histogram("edge", "e", buckets=(0.1, 0.25, 1.0))
+        h.observe(0.25)
+        fam = h.collect()
+        buckets = {
+            s.labels["le"]: s.value
+            for s in fam.samples
+            if s.suffix == "_bucket"
+        }
+        assert buckets["0.1"] == 0
+        assert buckets["0.25"] == 1  # on the boundary: counted here
+        assert buckets["1"] == 1
+        assert buckets["+Inf"] == 1
+
+    def test_all_observations_above_every_bound(self):
+        """An +Inf-only population: every bucket 0, overflow carries
+        the count, `_sum` still exact."""
+        reg = MetricsRegistry()
+        h = reg.histogram("over", "o", buckets=(1.0,))
+        h.observe(5.0)
+        h.observe(7.0)
+        fam = h.collect()
+        by = {(s.suffix, s.labels.get("le")): s.value for s in fam.samples}
+        assert by[("_bucket", "1")] == 0
+        assert by[("_bucket", "+Inf")] == 2
+        assert by[("_count", None)] == 2
+        assert by[("_sum", None)] == 12.0
+
+    def test_zero_observation_family_collects_empty(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("silent", "s", ("lane",))
+        fam = h.collect()
+        assert fam.mtype == "histogram"
+        assert fam.samples == []
+
+    def test_cumulative_count_and_le_index(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("cum", "c", buckets=(0.1, 0.25, 1.0))
+        for v in (0.05, 0.2, 0.25, 0.5, 3.0):
+            h.observe(v)
+        assert h.le_index(0.25) == 1
+        assert h.le_index(0.3) == 2  # snaps up to the 1.0 bound
+        assert h.le_index(99.0) == 3  # past every bound
+        assert h.cumulative_count(0) == 1  # <= 0.1
+        assert h.cumulative_count(1) == 3  # <= 0.25 inclusive
+        assert h.cumulative_count(2) == 4  # <= 1.0
+        assert h.cumulative_count(3) == 5  # everything
+        assert h.get_sum() == pytest.approx(4.0)
+
+    def test_exemplar_stored_per_bucket_latest_wins(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("exm", "x", buckets=(0.1, 1.0))
+        h.observe(0.05, trace_id="old")
+        h.observe(0.07, trace_id="new")
+        h.observe(0.5)  # no trace: leaves no exemplar
+        fam = h.collect()
+        by_le = {
+            s.labels["le"]: s for s in fam.samples
+            if s.suffix == "_bucket"
+        }
+        assert by_le["0.1"].exemplar.labels == {"trace_id": "new"}
+        assert by_le["0.1"].exemplar.value == 0.07
+        assert by_le["1"].exemplar is None
+        assert by_le["+Inf"].exemplar is None
+
+    def test_exemplar_lands_in_overflow_bucket(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("exo", "x", buckets=(0.1,))
+        h.observe(9.0, trace_id="slowpoke")
+        fam = h.collect()
+        (inf_sample,) = [
+            s for s in fam.samples
+            if s.suffix == "_bucket" and s.labels["le"] == "+Inf"
+        ]
+        assert inf_sample.exemplar.labels == {"trace_id": "slowpoke"}

@@ -1,0 +1,272 @@
+"""SLO burn-rate evaluation: windowed deltas over cumulative series,
+latency/availability constructors, gauges, /slz rendering, and the
+sampling thread."""
+
+import threading
+
+import pytest
+
+from keystone_tpu.observability.registry import MetricsRegistry
+from keystone_tpu.observability.slo import (
+    Slo,
+    SloMonitor,
+    monitors,
+    slz_status,
+)
+
+
+def make_counting_slo(name="api", target=0.99):
+    """An Slo over a hand-cranked cumulative (total, bad) pair."""
+    state = {"total": 0.0, "bad": 0.0}
+
+    def read():
+        return state["total"], state["bad"]
+
+    return Slo(name, target, read), state
+
+
+class TestBurnRateMath:
+    def test_burn_is_bad_fraction_over_budget(self):
+        slo, state = make_counting_slo(target=0.99)  # budget 1%
+        mon = SloMonitor(
+            fast_window_s=10, slow_window_s=100,
+            registry=MetricsRegistry(),
+        )
+        mon.add(slo)
+        mon.sample(now=0.0)
+        state["total"], state["bad"] = 100.0, 2.0  # 2% bad in-window
+        mon.sample(now=10.0)
+        burns = mon.burn_rates("api")
+        # 2% bad against a 1% budget = burn 2.0, on both windows (the
+        # slow window falls back to the oldest sample while young)
+        assert burns["fast"] == pytest.approx(2.0)
+        assert burns["slow"] == pytest.approx(2.0)
+
+    def test_burn_one_means_budget_exactly(self):
+        slo, state = make_counting_slo(target=0.999)  # budget 0.1%
+        mon = SloMonitor(
+            fast_window_s=10, slow_window_s=100,
+            registry=MetricsRegistry(),
+        )
+        mon.add(slo)
+        mon.sample(now=0.0)
+        state["total"], state["bad"] = 1000.0, 1.0
+        mon.sample(now=10.0)
+        assert mon.burn_rates("api")["fast"] == pytest.approx(1.0)
+
+    def test_no_traffic_burns_nothing(self):
+        slo, state = make_counting_slo()
+        mon = SloMonitor(
+            fast_window_s=10, slow_window_s=100,
+            registry=MetricsRegistry(),
+        )
+        mon.add(slo)
+        mon.sample(now=0.0)
+        mon.sample(now=10.0)  # no deltas
+        assert mon.burn_rates("api")["fast"] == 0.0
+
+    def test_single_sample_has_no_burn_yet(self):
+        slo, _ = make_counting_slo()
+        mon = SloMonitor(registry=MetricsRegistry())
+        mon.add(slo)
+        mon.sample(now=0.0)
+        assert mon.burn_rates("api") == {"fast": None, "slow": None}
+
+    def test_fast_window_recovers_while_slow_remembers(self):
+        """The multiwindow point: after a burst stops, the fast burn
+        falls to 0 quickly while the slow window still shows it."""
+        slo, state = make_counting_slo(target=0.99)
+        mon = SloMonitor(
+            fast_window_s=10, slow_window_s=1000,
+            registry=MetricsRegistry(),
+        )
+        mon.add(slo)
+        mon.sample(now=0.0)
+        state["total"], state["bad"] = 100.0, 50.0  # the burst
+        mon.sample(now=5.0)
+        state["total"] = 200.0  # clean traffic afterwards
+        mon.sample(now=30.0)
+        burns = mon.burn_rates("api")
+        # fast window (last 10 s) saw 100 clean requests, 0 bad
+        assert burns["fast"] == 0.0
+        # slow window still contains the burst: 50/200 bad / 1% budget
+        assert burns["slow"] == pytest.approx(25.0)
+        assert not mon.breaching("api")  # fast recovered -> not both
+
+    def test_breaching_needs_both_windows(self):
+        slo, state = make_counting_slo(target=0.99)
+        mon = SloMonitor(
+            fast_window_s=10, slow_window_s=100,
+            registry=MetricsRegistry(),
+        )
+        mon.add(slo)
+        mon.sample(now=0.0)
+        state["total"], state["bad"] = 100.0, 50.0
+        mon.sample(now=10.0)
+        assert mon.breaching("api")  # young: both windows see the burst
+
+    def test_history_pruned_beyond_slow_window(self):
+        slo, state = make_counting_slo()
+        mon = SloMonitor(
+            fast_window_s=1, slow_window_s=10,
+            registry=MetricsRegistry(),
+        )
+        mon.add(slo)
+        for t in range(100):
+            state["total"] += 10
+            mon.sample(now=float(t))
+        # one baseline older than the slow window + in-window samples
+        assert len(mon._samples["api"]) <= 13
+
+    def test_counter_reset_does_not_go_negative(self):
+        slo, state = make_counting_slo()
+        mon = SloMonitor(
+            fast_window_s=10, slow_window_s=100,
+            registry=MetricsRegistry(),
+        )
+        mon.add(slo)
+        state["total"], state["bad"] = 100.0, 10.0
+        mon.sample(now=0.0)
+        state["total"], state["bad"] = 150.0, 0.0  # bad "reset"
+        mon.sample(now=10.0)
+        assert mon.burn_rates("api")["fast"] >= 0.0
+
+
+class TestConstructors:
+    def test_latency_slo_reads_histogram_buckets(self):
+        reg = MetricsRegistry()
+        hist = reg.histogram(
+            "lat", "l", ("gw",), buckets=(0.1, 0.25, 1.0)
+        )
+        slo = Slo.latency(
+            "gw:latency", hist, threshold_s=0.25, target=0.9,
+            labels=("g0",),
+        )
+        assert slo.threshold_s == 0.25  # on a bucket edge: exact
+        for v in (0.05, 0.2, 0.25):  # all good (le 0.25 is inclusive)
+            hist.observe(v, ("g0",))
+        hist.observe(0.5, ("g0",))  # bad
+        total, bad = slo.read()
+        assert (total, bad) == (4.0, 1.0)
+
+    def test_latency_threshold_snaps_up_to_bucket_resolution(self):
+        reg = MetricsRegistry()
+        hist = reg.histogram("lat2", "l", (), buckets=(0.1, 1.0))
+        slo = Slo.latency("x", hist, threshold_s=0.5, target=0.9)
+        assert slo.threshold_s == 1.0  # smallest bound >= 0.5
+        assert "declared 500ms" in slo.description
+
+    def test_availability_slo_reads_outcome_counter(self):
+        reg = MetricsRegistry()
+        c = reg.counter("req", "r", ("gw", "status"))
+        slo = Slo.availability(
+            "gw:avail", c, target=0.999, base_labels=("g0",)
+        )
+        c.inc(("g0", "ok"), by=95)
+        c.inc(("g0", "shed"), by=3)  # deliberate, not "bad" by default
+        c.inc(("g0", "error"), by=2)
+        total, bad = slo.read()
+        assert (total, bad) == (100.0, 2.0)
+
+    def test_latency_threshold_beyond_buckets_rejected(self):
+        """A threshold past the largest finite bucket would snap to
+        +Inf — every observation counts as good and the objective can
+        never burn. Fail loud at declaration time instead."""
+        reg = MetricsRegistry()
+        hist = reg.histogram("lat3", "l", (), buckets=(0.1, 1.0))
+        with pytest.raises(ValueError, match="unobservable"):
+            Slo.latency("x", hist, threshold_s=5.0, target=0.9)
+
+    def test_target_must_be_a_fraction(self):
+        with pytest.raises(ValueError):
+            Slo("x", 1.0, lambda: (0, 0))
+        with pytest.raises(ValueError):
+            Slo("x", 0.0, lambda: (0, 0))
+
+    def test_duplicate_name_rejected(self):
+        mon = SloMonitor(registry=MetricsRegistry())
+        slo, _ = make_counting_slo()
+        mon.add(slo)
+        with pytest.raises(ValueError):
+            mon.add(make_counting_slo()[0])
+
+
+class TestExportAndStatus:
+    def test_burn_gauge_exported(self):
+        reg = MetricsRegistry()
+        slo, state = make_counting_slo(target=0.99)
+        mon = SloMonitor(
+            fast_window_s=10, slow_window_s=100, registry=reg
+        )
+        mon.add(slo)
+        mon.sample(now=0.0)
+        state["total"], state["bad"] = 100.0, 2.0
+        mon.sample(now=10.0)
+        fams = {f.name: f for f in reg.collect()}
+        fam = fams["keystone_slo_burn_rate"]
+        cells = {
+            (s.labels["slo"], s.labels["window"]): s.value
+            for s in fam.samples
+        }
+        assert cells[("api", "fast")] == pytest.approx(2.0)
+
+    def test_status_and_slz_render(self):
+        reg = MetricsRegistry()
+        slo, state = make_counting_slo()
+        mon = SloMonitor(
+            fast_window_s=10, slow_window_s=100, registry=reg
+        )
+        mon.add(slo)
+        state["total"] = 10.0
+        mon.sample(now=0.0)
+        status = mon.status()
+        (entry,) = status["slos"]
+        assert entry["name"] == "api"
+        assert entry["total"] == 10.0
+        assert entry["burn_rate"] == {"fast": None, "slow": None}
+        # module-level view (the /slz source) includes this monitor
+        assert mon in monitors()
+        assert any(
+            s["name"] == "api" for s in slz_status()["slos"]
+        )
+
+    def test_listener_fires_per_sample(self):
+        mon = SloMonitor(registry=MetricsRegistry())
+        slo, _ = make_counting_slo()
+        mon.add(slo)
+        hits = []
+        mon.add_listener(lambda m: hits.append(m))
+        mon.sample(now=0.0)
+        mon.sample(now=1.0)
+        assert hits == [mon, mon]
+
+    def test_broken_listener_does_not_stop_sampling(self):
+        mon = SloMonitor(registry=MetricsRegistry())
+        slo, state = make_counting_slo()
+        mon.add(slo)
+
+        def boom(m):
+            raise RuntimeError("listener bug")
+
+        mon.add_listener(boom)
+        mon.sample(now=0.0)
+        state["total"] = 5.0
+        mon.sample(now=1.0)  # must not raise
+        assert mon.burn_rates("api")["fast"] == 0.0
+
+
+def test_sampling_thread_runs_and_stops():
+    mon = SloMonitor(
+        fast_window_s=0.05, slow_window_s=1.0,
+        registry=MetricsRegistry(),
+    )
+    slo, state = make_counting_slo()
+    mon.add(slo)
+    sampled = threading.Event()
+    mon.add_listener(lambda m: sampled.set())
+    mon.start(interval_s=0.01)
+    try:
+        assert sampled.wait(5.0), "sampler thread never fired"
+    finally:
+        mon.stop()
+    assert mon._thread is None

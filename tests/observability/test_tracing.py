@@ -123,3 +123,158 @@ def test_out_of_order_end_is_tolerated():
     tr.end_span(a)  # ended before its child
     tr.end_span(b)
     assert {s.name for s in tr.recent()} == {"a", "b"}
+
+
+# -- trace ids (request identity across the span tree) ---------------------
+
+
+def test_trace_id_shared_down_the_tree():
+    tr = Tracer()
+    with tr.span("root") as root:
+        assert root.trace_id is not None and len(root.trace_id) == 32
+        with tr.span("child") as child:
+            assert child.trace_id == root.trace_id
+    spans = {s.name: s for s in tr.recent()}
+    assert spans["child"].trace_id == spans["root"].trace_id
+
+
+def test_separate_roots_get_separate_traces():
+    tr = Tracer()
+    with tr.span("a") as a:
+        pass
+    with tr.span("b") as b:
+        pass
+    assert a.trace_id != b.trace_id
+
+
+def test_pinned_cross_thread_parent_joins_the_trace():
+    """The gateway chain: the admit span ENDS before the coalesce span
+    starts on another thread, yet the pinned parent_id must carry the
+    trace id across."""
+    tr = Tracer()
+    with tr.span("gateway.admit") as admit:
+        pass  # finished before the dispatcher thread runs
+
+    def dispatcher():
+        with tr.span("microbatch.coalesce", parent_id=admit.span_id):
+            with tr.span("serving.dispatch"):
+                pass
+
+    t = threading.Thread(target=dispatcher)
+    t.start()
+    t.join()
+    spans = {s.name: s for s in tr.recent()}
+    assert spans["microbatch.coalesce"].trace_id == admit.trace_id
+    assert spans["serving.dispatch"].trace_id == admit.trace_id
+    assert tr.spans_for_trace(admit.trace_id) == tr.recent()
+
+
+def test_unknown_pinned_parent_roots_a_new_trace():
+    tr = Tracer()
+    with tr.span("orphan", parent_id=999_999_999) as sp:
+        pass
+    assert sp.trace_id is not None
+    (done,) = tr.recent()
+    assert done.parent_id == 999_999_999
+
+
+def test_spans_for_trace_filters_the_ring():
+    tr = Tracer()
+    with tr.span("t1") as a:
+        pass
+    with tr.span("t2"):
+        pass
+    only = tr.spans_for_trace(a.trace_id)
+    assert [s.name for s in only] == ["t1"]
+    assert tr.spans_for_trace("") == []
+
+
+def test_chrome_trace_args_carry_trace_id():
+    tr = Tracer()
+    with tr.span("x") as sp:
+        pass
+    (event,) = tr.to_chrome_trace()["traceEvents"]
+    assert event["args"]["trace_id"] == sp.trace_id
+
+
+# -- sinks -----------------------------------------------------------------
+
+
+def test_sink_sees_finished_spans_and_unhooks():
+    tr = Tracer()
+    seen = []
+    tr.add_sink(seen.append)
+    with tr.span("observed"):
+        pass
+    assert [s.name for s in seen] == ["observed"]
+    tr.remove_sink(seen.append)
+    with tr.span("unobserved"):
+        pass
+    assert len(seen) == 1
+
+
+def test_broken_sink_does_not_break_spans():
+    tr = Tracer()
+
+    def boom(span):
+        raise RuntimeError("exporter bug")
+
+    tr.add_sink(boom)
+    with tr.span("survives"):
+        pass
+    assert [s.name for s in tr.recent()] == ["survives"]
+
+
+# -- enable_tracing capacity swap vs concurrent writers --------------------
+
+
+def test_enable_tracing_capacity_swap_is_atomic_with_writers():
+    """Regression: enable_tracing(capacity=...) rebuilt the global
+    ring via deque(old, maxlen=new) WITHOUT the tracer lock — a
+    concurrent end_span could append mid-copy (RuntimeError: deque
+    mutated during iteration) or land its span in the doomed old ring.
+    The swap now happens under the tracer lock."""
+    tr = enable_tracing()
+    tr.clear()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            try:
+                span = tr.start_span(f"w{i}")
+                tr.end_span(span)
+            except Exception as e:  # the pre-fix failure mode
+                errors.append(e)
+                return
+            i += 1
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        # hammer the resize path against the writers
+        for round_ in range(200):
+            enable_tracing(capacity=64 + (round_ % 2))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        disable_tracing()
+        tr.clear()
+    assert errors == []
+
+
+def test_enable_tracing_preserves_recent_spans_across_resize():
+    tr = enable_tracing(capacity=8)
+    try:
+        tr.clear()
+        with tr.span("keep-me"):
+            pass
+        enable_tracing(capacity=16)
+        assert any(s.name == "keep-me" for s in tr.recent())
+        assert tr._ring.maxlen == 16
+    finally:
+        disable_tracing()
+        tr.clear()
