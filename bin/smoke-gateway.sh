@@ -121,6 +121,52 @@ do
 done
 echo "PASS /metrics ($(grep -c '^keystone_gateway' <<<"$METRICS") gateway lines)"
 
+# staged lane pipeline: every lane dispatches through host-prep ->
+# upload -> compute -> deliver stage threads (pipeline_depth=2 is the
+# gateway default), so the per-stage seconds series, window counter,
+# bottleneck attribution, and overlap-efficiency gauge must be on the
+# scrape, and /tracez must show the per-stage spans parented under the
+# window's microbatch.coalesce span
+for want in \
+    'keystone_serving_stage_seconds_count{engine="smoke-lane0",stage="host_prep"}' \
+    'keystone_serving_stage_seconds_count{engine="smoke-lane0",stage="upload"}' \
+    'keystone_serving_stage_seconds_count{engine="smoke-lane0",stage="compute"}' \
+    'keystone_serving_stage_seconds_count{engine="smoke-lane0",stage="deliver"}' \
+    'keystone_serving_pipeline_windows_total{engine="smoke-lane0"}' \
+    '# TYPE keystone_serving_pipeline_bottleneck gauge' \
+    'keystone_serving_pipeline_overlap_efficiency{engine="smoke-lane0"}' \
+    'keystone_serving_stage_queue_depth{engine="smoke-lane0",stage="host_prep"}'
+do
+    grep -qF "$want" <<<"$METRICS" || {
+        echo "FAIL: /metrics missing pipeline series: $want"
+        echo "$METRICS" | grep keystone_serving || true; exit 1; }
+done
+echo "PASS /metrics pipeline stage series"
+
+TRACEZ="$(fetch "$BASE/tracez")"
+for span in pipeline.host_prep pipeline.upload pipeline.compute \
+    pipeline.deliver microbatch.coalesce gateway.admit
+do
+    grep -qF "\"$span\"" <<<"$TRACEZ" || {
+        echo "FAIL: /tracez missing span: $span"; exit 1; }
+done
+# the stage spans carry the coalesce span as parent (cross-thread link)
+printf '%s' "$TRACEZ" | python -c '
+import json, sys
+doc = json.load(sys.stdin)
+spans = {}
+for s in doc["spans"]:
+    spans.setdefault(s["name"], []).append(s)
+coalesce_ids = {s["span_id"] for s in spans.get("microbatch.coalesce", [])}
+for name in ("pipeline.host_prep", "pipeline.upload",
+             "pipeline.compute", "pipeline.deliver"):
+    assert any(
+        s.get("parent_id") in coalesce_ids for s in spans.get(name, [])
+    ), f"{name} spans are not parented under microbatch.coalesce"
+print("stage span chain OK")
+' || exit 1
+echo "PASS /tracez pipeline stage spans"
+
 # forensic chain: the SLO objectives render at /slz with burn rates,
 # the injected-slow requests are tail-sampled at /debugz with their
 # span trees, and the latency histogram links to them via exemplars

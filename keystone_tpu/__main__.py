@@ -120,8 +120,13 @@ def main(argv=None) -> int:
         print("                   controlled HTTP inference frontend "
               "(POST /predict, GET /readyz,")
         print("                   POST /swap) with N replica lanes and "
-              "live re-bucketing. N=0")
-        print("                   picks an ephemeral port.")
+              "live re-bucketing. Lanes")
+        print("                   run as staged pipelines — host-prep/"
+              "upload/compute of")
+        print("                   consecutive windows overlap "
+              "(--pipeline-depth 0 reverts to")
+        print("                   serial dispatch). N=0 picks an "
+              "ephemeral port.")
         print("  --admin-port N   serve metrics on http://127.0.0.1:N —"
               " /metrics (Prometheus")
         print("                   text exposition of every live engine's"

@@ -22,9 +22,12 @@ A stdlib ``http.server`` on a background daemon thread, following the
 - ``GET /metrics`` — Prometheus exposition of the (global) registry,
   so a gateway-only deployment is scrapeable without the admin server
   (latency-histogram buckets carry ``trace_id`` exemplars).
-- ``GET /slz`` / ``GET /debugz`` — the SLO burn-rate and
-  flight-recorder surfaces, mirrored from the admin endpoint for
-  single-port deployments.
+- ``GET /slz`` / ``GET /debugz`` / ``GET /tracez`` — the SLO
+  burn-rate, flight-recorder, and recent-span surfaces, mirrored from
+  the admin endpoint for single-port deployments (``/tracez`` shows
+  the per-window ``microbatch.coalesce`` → ``pipeline.host_prep`` /
+  ``.upload`` / ``.compute`` / ``.deliver`` stage chains when the
+  lanes run pipelined and tracing is on).
 - ``POST /swap`` — force one lifecycle iteration
   (``Gateway.rebucket(force=True)``); returns the active bucket set.
   The smoke script's forced-swap drill.
@@ -120,11 +123,26 @@ class _Handler(JsonHandler):
                     q.get("format", [""])[0],
                 )
                 self._send_json(doc, code=code, indent=1)
+            elif path == "/tracez":
+                from keystone_tpu.observability.tracing import (
+                    get_tracer,
+                    tracez_document,
+                )
+
+                q = parse_qs(url.query)
+                self._send_json(
+                    tracez_document(
+                        get_tracer(),
+                        q.get("format", [""])[0],
+                        q["n"][0] if "n" in q else None,
+                    ),
+                    indent=1,
+                )
             else:
                 self._send_text(
                     404,
                     "not found; try /predict /readyz /healthz /metrics "
-                    "/slz /debugz\n",
+                    "/slz /debugz /tracez\n",
                 )
         except Exception as e:
             logger.exception("gateway GET error for %s", self.path)
@@ -336,6 +354,10 @@ def main(argv=None) -> int:
     ap.add_argument("--lanes", type=int, default=2)
     ap.add_argument("--max-pending", type=int, default=1024)
     ap.add_argument("--max-delay-ms", type=float, default=5.0)
+    ap.add_argument("--pipeline-depth", type=int, default=2,
+                    help="stage-queue depth of each lane's staged "
+                    "pipeline (host-prep/upload/compute/deliver "
+                    "overlap across windows); 0 = serial dispatch")
     ap.add_argument("--deadline-ms", type=float, default=None,
                     help="default per-request deadline")
     ap.add_argument("--rebucket-interval", type=float, default=None,
@@ -376,6 +398,7 @@ def main(argv=None) -> int:
         buckets=tuple(int(b) for b in args.buckets.split(",")),
         n_lanes=args.lanes,
         max_delay_ms=args.max_delay_ms,
+        pipeline_depth=args.pipeline_depth,
         warmup_example=jnp.zeros((args.d,), jnp.float32),
         max_pending=args.max_pending,
         default_deadline_ms=args.deadline_ms,

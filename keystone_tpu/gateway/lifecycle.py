@@ -1,8 +1,10 @@
 """Gateway: engine lifecycle, not just engine execution.
 
 ``Gateway`` composes the request plane — ``AdmissionController`` in
-front of an ``EnginePool`` — and owns everything about the engines'
-*lives*:
+front of an ``EnginePool`` whose lanes run as staged pipelines by
+default (``pipeline_depth=2``: host-prep, H2D upload, and device
+compute of consecutive windows overlap; serving/pipeline.py) — and
+owns everything about the engines' *lives*:
 
 - **build + warm** — lanes come up with every bucket compiled before
   the gateway reports ready (``warmup_example``), so cold compiles
@@ -81,6 +83,19 @@ class Gateway:
                        every bucket at construction and after each
                        swap; without it lanes compile lazily and the
                        first requests eat the compiles.
+    pipeline_depth:    stage-queue depth of each lane's STAGED pipeline
+                       (serving/pipeline.py): window k+1's host-prep
+                       and H2D upload overlap window k's device
+                       compute, results bit-identical to serial. The
+                       default (2) double-buffers every handoff; 0
+                       reverts the lanes to strictly serial dispatch.
+    host_featurize:    optional items-mode prep hook — a callable
+                       turning one coalesced window of RAW examples
+                       (arrays, strings, records...) into the batched
+                       array tree the lane engines stage. Runs on the
+                       host-prep stage (or inline when serial), so
+                       tokenizer/featurizer front-ends burn host cores
+                       while the device computes the previous window.
     max_pending:       admission queue bound.
     default_deadline_ms: deadline applied to requests that don't carry
                        their own.
@@ -116,6 +131,8 @@ class Gateway:
         max_delay_ms: float = 5.0,
         lane_capacity: Optional[int] = None,
         warmup_example: Any = None,
+        pipeline_depth: int = 2,
+        host_featurize=None,
         max_pending: int = 1024,
         default_deadline_ms: Optional[float] = None,
         maintenance_interval_s: Optional[float] = None,
@@ -149,6 +166,8 @@ class Gateway:
             max_delay_ms=max_delay_ms,
             lane_capacity=lane_capacity,
             metrics=self.metrics,
+            pipeline_depth=pipeline_depth,
+            host_featurize=host_featurize,
         )
         if warmup_example is not None:
             self.pool.warmup(warmup_example)

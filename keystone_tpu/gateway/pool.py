@@ -3,8 +3,13 @@
 The ROADMAP's "shared-nothing request plane": N lanes, each a private
 ``MicroBatcher`` + ``CompiledPipeline`` pair — no cross-lane state, so
 lanes scale like independent hosts (and the same topology drops onto
-one-engine-per-host multi-host serving later). The pool adds the three
-things a replica set needs beyond execution:
+one-engine-per-host multi-host serving later). With
+``pipeline_depth > 0`` each lane's batcher runs as a STAGED PIPELINE
+(serving/pipeline.py: host-prep / upload / compute / deliver threads
+behind bounded handoff queues), overlapping one window's host work
+with the previous window's device compute; ``host_featurize`` plugs an
+items-mode front-end into every lane's prep stage. The pool adds the
+three things a replica set needs beyond execution:
 
 - **least-loaded routing** — ``submit()`` hands each request to the
   healthy lane with the fewest unresolved requests, so one slow window
@@ -62,9 +67,16 @@ class Lane:
         index: int,
         max_delay_ms: float = 5.0,
         capacity: Optional[int] = None,
+        pipeline_depth: int = 0,
+        host_featurize=None,
     ):
         self.index = index
-        self.batcher = MicroBatcher(engine, max_delay_ms=max_delay_ms)
+        self.batcher = MicroBatcher(
+            engine,
+            max_delay_ms=max_delay_ms,
+            pipeline_depth=pipeline_depth,
+            host_featurize=host_featurize,
+        )
         self._capacity_pinned = int(capacity) if capacity else None
         self._lock = threading.Lock()
         self._inflight = 0
@@ -75,13 +87,17 @@ class Lane:
     def capacity(self) -> int:
         """How many unresolved requests this lane will hold before the
         admission router stops feeding it: two full windows keeps the
-        batcher's next window filling while one executes. Unless pinned
-        it tracks the CURRENT engine's window size, so a rebucket to
-        larger buckets also widens the lane (a frozen bound would cap
-        throughput at the old bucket's scale)."""
+        batcher's next window filling while one executes — plus one
+        window per pipeline stage-depth when the lane is a staged
+        pipeline, so the prep/upload/compute stages all have a window
+        to chew on. Unless pinned it tracks the CURRENT engine's window
+        size, so a rebucket to larger buckets also widens the lane (a
+        frozen bound would cap throughput at the old bucket's scale)."""
         if self._capacity_pinned is not None:
             return self._capacity_pinned
-        return 2 * self.batcher.max_batch
+        return (
+            (2 + self.batcher.pipeline_depth) * self.batcher.max_batch
+        )
 
     @property
     def engine(self) -> CompiledPipeline:
@@ -149,6 +165,8 @@ class EnginePool:
         lane_capacity: Optional[int] = None,
         max_retries: int = 1,
         metrics=None,  # GatewayMetrics; duck-typed so tests can stub
+        pipeline_depth: int = 0,
+        host_featurize=None,
     ):
         if n_lanes < 1:
             raise ValueError(f"need at least one lane, got {n_lanes}")
@@ -169,6 +187,8 @@ class EnginePool:
                 i,
                 max_delay_ms=max_delay_ms,
                 capacity=lane_capacity,
+                pipeline_depth=pipeline_depth,
+                host_featurize=host_featurize,
             )
             for i in range(n_lanes)
         ]

@@ -45,7 +45,11 @@ from keystone_tpu.observability.registry import (
     MetricsRegistry,
     get_global_registry,
 )
-from keystone_tpu.observability.tracing import Tracer, get_tracer
+from keystone_tpu.observability.tracing import (
+    Tracer,
+    get_tracer,
+    tracez_document,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -164,19 +168,14 @@ class _Handler(JsonHandler):
                 self._send_json(doc, indent=1)
             elif url.path == "/tracez":
                 q = parse_qs(url.query)
-                if q.get("format", [""])[0] == "chrome":
-                    self._send_json(tracer.to_chrome_trace(), indent=1)
-                else:
-                    n = int(q["n"][0]) if "n" in q else None
-                    self._send_json(
-                        {
-                            "enabled": tracer.enabled,
-                            "spans": [
-                                s.to_dict() for s in tracer.recent(n)
-                            ],
-                        },
-                        indent=1,
-                    )
+                self._send_json(
+                    tracez_document(
+                        tracer,
+                        q.get("format", [""])[0],
+                        q["n"][0] if "n" in q else None,
+                    ),
+                    indent=1,
+                )
             elif url.path == "/slz":
                 self._send_json(slo.slz_status(), indent=1)
             elif url.path == "/debugz":

@@ -4,7 +4,12 @@ recent spans, and Chrome trace-event JSON export.
 Dapper-style application-level spans for the interpret layer — the JAX
 profiler (``utils.profiling.trace``) already covers the XLA/device
 substrate, but nothing records *why* the device was asked to do work:
-which executor node, which serving dispatch, which coalesced window.
+which executor node, which serving dispatch, which coalesced window,
+which lane-pipeline stage. Span names in the serving path:
+``gateway.admit`` → ``microbatch.coalesce`` → ``serving.dispatch``
+(serial lanes) or → ``pipeline.host_prep`` / ``pipeline.upload`` /
+``pipeline.compute`` / ``pipeline.deliver`` (staged lanes, one span
+per stage per window, each on its own stage thread).
 Spans nest via a thread-local stack, so a ``serving.dispatch`` span
 started inside a ``microbatch.dispatch`` span carries its parent's id —
 ``/tracez`` (observability/admin.py) shows the tree, and
@@ -318,3 +323,20 @@ def enable_tracing(capacity: Optional[int] = None) -> Tracer:
 
 def disable_tracing() -> None:
     _global_tracer.enabled = False
+
+
+def tracez_document(
+    tracer: Tracer, fmt: str = "", n_raw: Optional[str] = None
+) -> Dict[str, Any]:
+    """Build the ``/tracez`` response document — shared by the admin
+    endpoint and the gateway frontend (the way ``flight.debugz_document``
+    backs both ``/debugz`` routes) so the two handlers cannot drift.
+    ``fmt="chrome"`` returns the Chrome trace-event export; otherwise the
+    recent-span listing, optionally limited to the last ``n_raw`` spans."""
+    if fmt == "chrome":
+        return tracer.to_chrome_trace()
+    n = int(n_raw) if n_raw is not None else None
+    return {
+        "enabled": tracer.enabled,
+        "spans": [s.to_dict() for s in tracer.recent(n)],
+    }

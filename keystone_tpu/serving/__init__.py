@@ -13,6 +13,14 @@ production inference engine:
   into spec-homogeneous windows (interleaved request streams with
   different shapes each get their own) under a max-latency deadline,
   with a ``swap_engine()`` hook for zero-downtime engine replacement.
+- ``LanePipeline`` (pipeline.py): the staged serving lane behind
+  ``MicroBatcher(pipeline_depth=N)`` — host-prep (stack or a pluggable
+  ``host_featurize`` items-mode hook + pad into a reusable host buffer
+  pool), H2D upload, device compute, and deliver run on separate
+  threads behind bounded handoff queues, so one window's host work
+  overlaps the previous window's device compute. Bit-identical to
+  serial dispatch; per-stage spans/metrics with streaming-bench-style
+  bottleneck attribution.
 - ``ServingMetrics`` (metrics.py): per-bucket compile/dispatch counts,
   request-size histogram, queue depth, p50/p95/p99 latency, windowed
   examples/sec — auto-registered into the process-global
@@ -34,9 +42,17 @@ from keystone_tpu.serving.autoscale import padding_waste, suggest_buckets
 from keystone_tpu.serving.batching import MicroBatcher
 from keystone_tpu.serving.engine import CompiledPipeline
 from keystone_tpu.serving.metrics import ServingMetrics
+from keystone_tpu.serving.pipeline import (
+    HostBufferPool,
+    HostFeaturize,
+    LanePipeline,
+)
 
 __all__ = [
     "CompiledPipeline",
+    "HostBufferPool",
+    "HostFeaturize",
+    "LanePipeline",
     "MicroBatcher",
     "ServingMetrics",
     "padding_waste",

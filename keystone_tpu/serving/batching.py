@@ -27,7 +27,21 @@ recorded on the shared ``ServingMetrics``.
 (gateway/lifecycle.py): it atomically replaces the engine behind the
 batcher — queued and future windows dispatch through the replacement,
 the window already in flight completes on the old engine, and no
-request is dropped or reordered.
+request is dropped or reordered. In pipelined mode the swap also
+rebuilds the lane pipeline's host staging pool (bucket sizes may have
+changed); windows already in the stages carry their coalesce-time
+engine and finish on it.
+
+``pipeline_depth > 0`` turns the lane into a STAGED PIPELINE
+(serving/pipeline.py): instead of dispatching each window inline, the
+dispatcher hands it to per-stage threads (host-prep → upload → compute
+→ deliver) connected by bounded queues, so window k+1's host work and
+H2D transfer overlap window k's device compute. Results are
+bit-identical to the serial path — both compose the engine's same
+stage primitives over identical values. ``host_featurize`` plugs an
+items-mode front-end (e.g. a fused tokenizer) into the prep stage of
+EITHER mode: clients submit raw items, the hook turns each coalesced
+window into the batched array tree the engine stages.
 """
 
 from __future__ import annotations
@@ -44,11 +58,20 @@ import numpy as np
 
 from keystone_tpu.observability.tracing import get_tracer
 from keystone_tpu.serving.engine import CompiledPipeline
+from keystone_tpu.serving.pipeline import (
+    HostFeaturize,
+    LanePipeline,
+    resolve_window_futures,
+)
 
 logger = logging.getLogger(__name__)
 
 # (example, future, enqueue time, optional parent span id)
 _Entry = Tuple[Any, Future, float, Optional[int]]
+
+# all raw items coalesce into ONE stream when a host featurizer owns
+# the window: the hook defines homogeneity, not per-item array shape
+_ITEMS_SPEC = ("items",)
 
 
 class MicroBatcher:
@@ -57,6 +80,8 @@ class MicroBatcher:
         engine: CompiledPipeline,
         max_delay_ms: float = 5.0,
         max_batch: Optional[int] = None,
+        pipeline_depth: int = 0,
+        host_featurize: Optional[HostFeaturize] = None,
     ):
         self.engine = engine
         self.max_delay = max_delay_ms / 1e3
@@ -69,6 +94,18 @@ class MicroBatcher:
                 f"max_batch {self.max_batch} exceeds the engine's largest "
                 f"bucket {engine.max_bucket}"
             )
+        self.host_featurize = host_featurize
+        self.pipeline_depth = int(pipeline_depth)
+        # pipeline_depth > 0: dispatch through the staged lane pipeline
+        # (host-prep/upload/compute/deliver threads, bounded handoffs)
+        # instead of inline — see serving/pipeline.py
+        self._pipeline: Optional[LanePipeline] = (
+            LanePipeline(
+                self._assemble, depth=self.pipeline_depth,
+                name=engine.name,
+            )
+            if self.pipeline_depth > 0 else None
+        )
         self.metrics = engine.metrics
         # pending requests segregated by spec (treedef + leaf
         # shapes/dtypes): each spec coalesces into its own windows, so
@@ -97,6 +134,11 @@ class MicroBatcher:
         return a.shape, str(a.dtype)
 
     def _example_spec(self, example: Any):
+        if self.host_featurize is not None:
+            # items mode: the featurizer owns window homogeneity (raw
+            # strings/records have no stable per-item array spec), so
+            # every submission coalesces into one stream
+            return _ITEMS_SPEC
         leaves, treedef = jax.tree_util.tree_flatten(example)
         return treedef, tuple(self._leaf_spec(a) for a in leaves)
 
@@ -141,6 +183,11 @@ class MicroBatcher:
                     "engine's largest bucket %d; windows will chunk",
                     self.max_batch, engine.max_bucket,
                 )
+            if self._pipeline is not None:
+                # rebuild the host staging pool: its buffers are cut
+                # for the old bucket set; in-flight windows keep their
+                # coalesce-time engine and finish on it
+                self._pipeline.on_swap()
             self._cond.notify()
         return old
 
@@ -162,6 +209,10 @@ class MicroBatcher:
                 "futures will resolve as it finishes", timeout,
             )
             return
+        if self._pipeline is not None:
+            # the dispatcher has pushed every pending window into the
+            # stage chain; flush it through and stop the stage threads
+            self._pipeline.close(timeout=timeout)
         # a CLEAN worker exit provably drains _pending (submit rejects
         # once closed); anything left here means the dispatcher thread
         # died on an unexpected error outside _dispatch's catch — fail
@@ -227,6 +278,40 @@ class MicroBatcher:
                 return
             self._dispatch(batch, engine)
 
+    def _assemble(self, examples: List[Any]) -> Tuple[Any, bool]:
+        """One window of raw examples -> ``(batched tree, owned)``.
+        Shared by the serial dispatch and the pipeline's host-prep
+        stage, so both modes assemble identical values. ``owned`` is
+        False only on the single-entry fast path — the [1, ...] view
+        aliases the caller's buffers, so the engine must keep its
+        protective pre-donation copy."""
+        if self.host_featurize is not None:
+            # items mode: the hook turns raw items into the batched
+            # array tree (fresh buffers — featurizers allocate)
+            return self.host_featurize(list(examples)), True
+        if len(examples) == 1:
+            # single-entry fast path (common at low load): skip the
+            # stack copy; lift to a [1, ...] VIEW of the caller's tree
+            def lift(a):
+                if isinstance(a, jax.Array):
+                    return jnp.asarray(a)[None]
+                return np.asarray(a)[None]
+
+            return (
+                jax.tree_util.tree_map(lift, examples[0]),
+                False,
+            )
+
+        def stack(*xs):
+            # host payloads stack on HOST: the whole window then
+            # crosses to the device as ONE transfer inside the
+            # engine, not one per example
+            if any(isinstance(x, jax.Array) for x in xs):
+                return jnp.stack([jnp.asarray(x) for x in xs])
+            return np.stack([np.asarray(x) for x in xs])
+
+        return jax.tree_util.tree_map(stack, *examples), True
+
     def _dispatch(
         self, batch: List[_Entry], engine: CompiledPipeline
     ) -> None:
@@ -235,37 +320,31 @@ class MicroBatcher:
         enqueued = [t for _, _, t, _ in batch]
         metrics = engine.metrics
         metrics.record_coalesce(len(batch))
-        # the engine's serving.dispatch span nests under this one, so
-        # /tracez shows coalesce -> dispatch parent links per window;
-        # the window's parent is the FIRST request's upstream span (the
+        # the engine's serving.dispatch span (serial) or the
+        # pipeline.<stage> spans nest under this one, so /tracez shows
+        # coalesce -> dispatch/stage parent links per window; the
+        # window's parent is the FIRST request's upstream span (the
         # gateway.admit that has waited longest), linking the admit ->
-        # coalesce -> dispatch chain across threads
+        # coalesce -> stages chain across threads
         try:
             with get_tracer().span(
                 "microbatch.coalesce",
                 parent_id=batch[0][3],
                 engine=engine.name,
                 window=len(batch),
-            ):
-                def stack(*xs):
-                    # host payloads stack on HOST: the whole window then
-                    # crosses to the device as ONE transfer inside the
-                    # engine, not one per example
-                    if any(isinstance(x, jax.Array) for x in xs):
-                        return jnp.stack([jnp.asarray(x) for x in xs])
-                    return np.stack([np.asarray(x) for x in xs])
-
-                stacked = jax.tree_util.tree_map(stack, *examples)
-                out = engine.apply(stacked, sync=True, owned=True)
-            done = time.perf_counter()
-            for i, fut in enumerate(futures):
-                row = jax.tree_util.tree_map(lambda a, i=i: a[i], out)
-                try:
-                    fut.set_result(row)
-                except Exception:
-                    continue  # caller cancelled this request; the rest
-                    # of the batch must still get their results
-                metrics.record_request(done - enqueued[i])
+            ) as span:
+                if self._pipeline is not None:
+                    # blocks while the prep queue is full — the lane's
+                    # backpressure point (pending piles up behind the
+                    # batcher and admission sheds upstream)
+                    self._pipeline.submit_window(
+                        examples, futures, enqueued, engine,
+                        span.span_id,
+                    )
+                    return
+                stacked, owned = self._assemble(examples)
+                out = engine.apply(stacked, sync=True, owned=owned)
+            resolve_window_futures(metrics, out, futures, enqueued)
         except Exception as e:  # resolve, never hang callers
             for fut in futures:
                 if not fut.done():

@@ -17,6 +17,19 @@ support donation (TPU/GPU), so serving doesn't hold two copies of each
 padded batch. The optional sharded variant places each staged batch
 over the mesh data axis for multi-chip serving — same program, one
 compile per bucket, XLA inserts the collectives.
+
+The dispatch path is factored into stage primitives so the staged lane
+pipeline (``serving/pipeline.py``) can run them on separate threads —
+``host_stage`` (pad on host into a pooled reusable buffer),
+``upload_staged`` (H2D placement, sharded when the engine is), and
+``compute_staged`` (the compiled bucket program + dispatch counters) —
+while the serial ``apply``/``_dispatch`` path composes exactly the same
+primitives inline, which is what makes pipelined results bit-identical
+to serial ones. Owned-buffer contract: a staged tree handed to
+``compute_staged`` is engine-private by construction (``host_stage``
+wrote it, or the caller promised ``owned=True``) and is donated to XLA
+where the backend supports it — callers must never reuse buffers they
+passed with that promise.
 """
 
 from __future__ import annotations
@@ -27,6 +40,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from keystone_tpu.observability.tracing import get_tracer
 from keystone_tpu.parallel import mesh as mesh_lib
@@ -173,6 +187,51 @@ class CompiledPipeline:
             )
         return staged
 
+    # -- pipeline stage primitives (serving/pipeline.py runs these on
+    # -- separate threads; _dispatch composes them inline) ------------------
+
+    def host_stage(self, tree: Any, rows: int, bucket: int, out: Any) -> Any:
+        """HOST-side pad of a numpy pytree up to ``bucket`` rows with
+        zeros — the pipelined host-prep stage. ``out`` is a matching
+        pytree of preallocated ``(bucket, ...)`` buffers (the reusable
+        staging pool): valid rows are copied in and the pad region
+        zeroed, so steady-state windows allocate nothing on the host.
+        Returns ``out``."""
+        def fill_leaf(buf, a):
+            np.copyto(buf[:rows], np.asarray(a))
+            if bucket > rows:
+                buf[rows:] = 0
+            return buf
+
+        return jax.tree_util.tree_map(fill_leaf, out, tree)
+
+    def upload_staged(self, staged_host: Any) -> Any:
+        """H2D placement of a host-staged (already padded) tree — the
+        pipelined upload stage. Sharded engines place over the mesh
+        data axis; the transfer is async (callers that need the host
+        buffers back block on the returned arrays). The device buffers
+        are engine-private (the transfer copies), so downstream compute
+        may donate them."""
+        if self.shard:
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(
+                    a, mesh_lib.data_sharding(self.mesh, ndim=a.ndim)
+                ),
+                staged_host,
+            )
+        return jax.tree_util.tree_map(jax.device_put, staged_host)
+
+    def compute_staged(self, staged: Any, rows: int, bucket: int) -> Any:
+        """Dispatch the bucket's compiled program over an
+        already-staged (padded + placed) tree and record the dispatch
+        counters. ``staged`` must be engine-private — it is donated to
+        XLA where the backend supports donation. Returns the full
+        padded output (async; callers slice to ``rows`` valid rows and
+        own the sync point)."""
+        out = self._fn(bucket)(staged)
+        self.metrics.record_dispatch(bucket, rows)
+        return out
+
     # -- serving entry points ----------------------------------------------
 
     def apply(
@@ -203,6 +262,7 @@ class CompiledPipeline:
         # the protective copy; only the single-chunk identity slice can
         # alias the caller's array
         chunk_owned = owned or rows > self.max_bucket
+        t0 = time.perf_counter()
         start = 0
         while start < rows:
             take = min(self.max_bucket, rows - start)
@@ -218,6 +278,17 @@ class CompiledPipeline:
         )
         if sync:
             jax.block_until_ready(result)
+            # the completion-timed dispatch number: this sync is the
+            # first point the device work is provably done (the
+            # per-chunk timer above stops at enqueue — execution is
+            # async past the compiled call, so that number alone
+            # under-reported device time; it survives as the separate
+            # dispatch_enqueue series). Async callers own their sync
+            # point and record nothing here; the pipelined compute
+            # stage records its own completion number per window.
+            self.metrics.record_dispatch_complete(
+                time.perf_counter() - t0
+            )
         return result
 
     def _dispatch(self, chunk: Any, rows: int, owned: bool = False) -> Any:
@@ -227,10 +298,10 @@ class CompiledPipeline:
         ):
             t0 = time.perf_counter()
             staged = self._stage(chunk, rows, bucket, owned=owned)
-            out = self._fn(bucket)(staged)
+            out = self.compute_staged(staged, rows, bucket)
             valid = jax.tree_util.tree_map(lambda a: a[:rows], out)
-            self.metrics.record_dispatch(
-                bucket, rows, time.perf_counter() - t0
+            self.metrics.record_dispatch_enqueue(
+                time.perf_counter() - t0
             )
         return valid
 

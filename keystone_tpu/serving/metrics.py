@@ -7,6 +7,17 @@ counts, padded-vs-valid example counts (padding waste), the observed
 per-request size histogram (what the bucket autoscaler reads), dispatch
 and end-to-end request latency percentiles, and a queue-depth gauge.
 
+Pipelined-lane serving (``serving/pipeline.py``) adds per-stage series:
+a seconds recorder per stage (``host_prep``/``upload``/``compute``/
+``deliver``), per-stage handoff-queue depth gauges, a windows-completed
+counter, and the derived *bottleneck attribution* — the stage whose
+standalone rate (1 / mean stage seconds) is lowest, computed exactly
+the way the streaming featurize bench attributes its decode/upload/
+compute bottleneck — plus ``overlap_efficiency`` = sustained window
+rate over that bottleneck stage's rate (≈1.0 means the lane loses
+nothing to serialization; meaningful under saturation, it decays with
+idle gaps like every windowed rate here).
+
 Built on the generic ``Counter`` / ``LatencyRecorder`` primitives in
 ``utils/profiling.py`` so the same machinery serves training-side
 instrumentation — and bridged into the process-global
@@ -31,6 +42,10 @@ from keystone_tpu.utils.profiling import Counter, LatencyRecorder
 # default sliding window of the instantaneous throughput gauge
 RATE_WINDOW_S = 30.0
 
+# the staged lane pipeline's stages, in flow order (serving/pipeline.py);
+# bottleneck attribution ranges over these
+PIPELINE_STAGES = ("host_prep", "upload", "compute", "deliver")
+
 _engine_ids = itertools.count()
 
 
@@ -46,13 +61,28 @@ class ServingMetrics:
         # valid-row count of each dispatch (the observed request-size
         # histogram serving/autoscale.py proposes bucket sets from)
         self.request_sizes = Counter()
-        # wall time of engine dispatches: pad/placement + compiled-call
-        # ENQUEUE (execution is async; apply(sync=True) blocks once at
-        # the end, outside this number), plus trace+compile on a
-        # bucket's FIRST dispatch (warmup moves that cost out of the
-        # traffic distribution). End-to-end serving latency lives in
-        # request_latency and in the bench's own wall timers.
+        # COMPLETION-timed dispatch wall time: staging through the
+        # compiled program's results being ready, recorded at an
+        # explicit sync point (``apply(sync=True)`` / the pipelined
+        # compute stage). The old enqueue-only number under-reported
+        # device time (execution is async past the compiled call);
+        # it survives as its own series below.
         self.dispatch_latency = LatencyRecorder(latency_window)
+        # ENQUEUE-only dispatch time: pad/placement + compiled-call
+        # dispatch, excluding device execution (plus trace+compile on a
+        # bucket's FIRST dispatch; warmup moves that out of traffic).
+        self.dispatch_enqueue_latency = LatencyRecorder(latency_window)
+        # staged-lane pipeline stage seconds (busy time per window per
+        # stage) + per-stage handoff-queue depths + completed windows
+        self.stage_seconds: Dict[str, LatencyRecorder] = {
+            s: LatencyRecorder(latency_window) for s in PIPELINE_STAGES
+        }
+        self.windows = Counter()
+        self._stage_queue_depth: Dict[str, int] = {}
+        # (timestamp,) per completed pipeline window, pruned like
+        # _rate_events: the sustained-window-rate input of the
+        # overlap-efficiency gauge
+        self._window_events: Deque[float] = collections.deque()
         # enqueue-to-future-resolution time of micro-batched requests
         self.request_latency = LatencyRecorder(latency_window)
         self._queue_depth = 0
@@ -70,19 +100,55 @@ class ServingMetrics:
         self.compiles.inc(bucket)
 
     def record_dispatch(
-        self, bucket: int, n_valid: int, seconds: float
+        self, bucket: int, n_valid: int, seconds: Optional[float] = None
     ) -> None:
+        """One compiled-program dispatch: counters + rate events.
+        ``seconds``, when given, is a completion-timed wall number and
+        feeds ``dispatch_latency`` directly (callers that only know the
+        enqueue time use ``record_dispatch_enqueue`` and record the
+        completion number at their sync point)."""
         self.dispatches.inc(bucket)
         self.examples.inc(None, n_valid)
         self.padded_rows.inc(None, bucket - n_valid)
         self.request_sizes.inc(n_valid)
-        self.dispatch_latency.record(seconds)
+        if seconds is not None:
+            self.dispatch_latency.record(seconds)
         now = time.perf_counter()
         with self._lock:
             self._rate_events.append((now, n_valid))
             cutoff = now - RATE_WINDOW_S
             while self._rate_events and self._rate_events[0][0] < cutoff:
                 self._rate_events.popleft()
+
+    def record_dispatch_enqueue(self, seconds: float) -> None:
+        """Pad/placement + compiled-call dispatch time (no execution)."""
+        self.dispatch_enqueue_latency.record(seconds)
+
+    def record_dispatch_complete(self, seconds: float) -> None:
+        """Completion-timed dispatch wall time, recorded at the sync
+        point where the dispatched results became ready."""
+        self.dispatch_latency.record(seconds)
+
+    # -- pipeline-side hooks (serving/pipeline.py) -------------------------
+
+    def record_stage(self, stage: str, seconds: float) -> None:
+        rec = self.stage_seconds.get(stage)
+        if rec is not None:
+            rec.record(seconds)
+
+    def set_stage_queue_depth(self, stage: str, depth: int) -> None:
+        with self._lock:
+            self._stage_queue_depth[stage] = depth
+
+    def record_window(self) -> None:
+        """One pipelined window fully delivered."""
+        self.windows.inc(None)
+        now = time.perf_counter()
+        with self._lock:
+            self._window_events.append(now)
+            cutoff = now - RATE_WINDOW_S
+            while self._window_events and self._window_events[0] < cutoff:
+                self._window_events.popleft()
 
     # -- batcher-side hooks ------------------------------------------------
 
@@ -131,6 +197,83 @@ class ServingMetrics:
             )
         return served / window
 
+    # -- pipeline attribution (the streaming bench's model, per lane) ------
+
+    def stage_rates(self) -> Dict[str, float]:
+        """Windows/sec each stage could sustain STANDALONE, from its
+        mean busy seconds per window (1 / mean) — the per-lane analogue
+        of the streaming featurize bench's standalone stage probes."""
+        rates: Dict[str, float] = {}
+        for stage, rec in self.stage_seconds.items():
+            snap = rec.snapshot()
+            if snap["count"] and snap["total"] > 0:
+                rates[stage] = snap["count"] / snap["total"]
+        return rates
+
+    def bottleneck(self) -> Optional[Tuple[str, float]]:
+        """``(stage, rate)`` of the slowest stage — the same min-rate
+        attribution the streaming bench reports as ``bottleneck`` —
+        or None before any pipelined window ran."""
+        rates = self.stage_rates()
+        if not rates:
+            return None
+        stage = min(rates, key=rates.get)
+        return stage, rates[stage]
+
+    def windows_per_sec(self, window: float = RATE_WINDOW_S) -> float:
+        """Sustained pipelined-window completion rate (windowed like
+        ``examples_per_sec``)."""
+        now = time.perf_counter()
+        window = min(window, RATE_WINDOW_S, max(now - self._t0, 1e-9))
+        cutoff = now - window
+        with self._lock:
+            n = sum(1 for t in self._window_events if t >= cutoff)
+        return n / window
+
+    def overlap_efficiency(self) -> Optional[float]:
+        """Sustained window rate over the bottleneck stage's standalone
+        rate: ~1.0 means the lane pipeline loses nothing to
+        serialization (can exceed 1.0 — stages measured under overlap
+        run slower than they would standalone, making the model
+        conservative, exactly like the streaming bench's caveat).
+        Meaningful under saturation; decays toward 0 over idle gaps."""
+        bn = self.bottleneck()
+        if bn is None or bn[1] <= 0:
+            return None
+        return self.windows_per_sec() / bn[1]
+
+    def pipeline_report(self) -> Optional[Dict]:
+        """Per-stage seconds/rates + bottleneck attribution + overlap
+        efficiency for this lane (None before any pipelined window)."""
+        if not self.windows.total:
+            return None
+        rates = self.stage_rates()
+        stages = {}
+        for stage, rec in self.stage_seconds.items():
+            snap = rec.snapshot()
+            if not snap["count"]:
+                continue
+            stages[stage] = {
+                "mean_ms": round(
+                    snap["total"] / snap["count"] * 1e3, 3
+                ),
+                "p99_ms": round(snap["p99"] * 1e3, 3)
+                if snap["p99"] is not None else None,
+                "rate_per_s": round(rates.get(stage, 0.0), 1),
+            }
+        bn = self.bottleneck()
+        eff = self.overlap_efficiency()
+        with self._lock:
+            queue_depths = dict(self._stage_queue_depth)
+        return {
+            "windows": self.windows.total,
+            "windows_per_sec": round(self.windows_per_sec(), 2),
+            "stages": stages,
+            "stage_queue_depths": queue_depths,
+            "bottleneck": bn[0] if bn else None,
+            "overlap_efficiency": round(eff, 3) if eff is not None else None,
+        }
+
     def examples_per_sec_lifetime(self) -> float:
         """LIFETIME average (examples since construction / wall time
         since construction) — it decays over idle periods and includes
@@ -147,8 +290,10 @@ class ServingMetrics:
             return round(v * 1e3, 3) if v is not None else None
 
         dispatch = self.dispatch_latency.snapshot()
+        enqueue = self.dispatch_enqueue_latency.snapshot()
         request = self.request_latency.snapshot()
-        return {
+        pipeline = self.pipeline_report()
+        out = {
             "compiles_per_bucket": {
                 str(k): v for k, v in sorted(self.compiles.snapshot().items())
             },
@@ -165,12 +310,16 @@ class ServingMetrics:
             "dispatch_p50_ms": ms(dispatch["p50"]),
             "dispatch_p95_ms": ms(dispatch["p95"]),
             "dispatch_p99_ms": ms(dispatch["p99"]),
+            "dispatch_enqueue_p50_ms": ms(enqueue["p50"]),
             "request_p50_ms": ms(request["p50"]),
             "request_p95_ms": ms(request["p95"]),
             "request_p99_ms": ms(request["p99"]),
             "queue_depth": self.queue_depth,
             "max_coalesced": self.max_coalesced,
         }
+        if pipeline is not None:
+            out["pipeline"] = pipeline
+        return out
 
     # -- MetricsRegistry bridge --------------------------------------------
 
@@ -230,12 +379,95 @@ class ServingMetrics:
             out.append(Sample("_sum", {"engine": label}, snap["total"]))
             return out
 
+        def stage_families(m):
+            """Pipelined-lane families — emitted only once a staged
+            pipeline has run on this engine, so serial engines' scrapes
+            stay free of empty stage series."""
+            if not m.windows.total:
+                return []
+            quantiles = []
+            for stage, rec in sorted(m.stage_seconds.items()):
+                snap = rec.snapshot()
+                if not snap["count"]:
+                    continue
+                quantiles.extend(
+                    Sample(
+                        "",
+                        {
+                            "engine": label,
+                            "stage": stage,
+                            "quantile": repr(q),
+                        },
+                        snap[f"p{int(q * 100)}"],
+                    )
+                    for q in (0.5, 0.95, 0.99)
+                    if snap[f"p{int(q * 100)}"] is not None
+                )
+                quantiles.append(Sample(
+                    "_count", {"engine": label, "stage": stage},
+                    snap["count"],
+                ))
+                quantiles.append(Sample(
+                    "_sum", {"engine": label, "stage": stage},
+                    snap["total"],
+                ))
+            bn = m.bottleneck()
+            eff = m.overlap_efficiency()
+            with m._lock:
+                depths = dict(m._stage_queue_depth)
+            return [
+                MetricFamily(
+                    "keystone_serving_stage_seconds", "summary",
+                    "staged-lane pipeline busy seconds per window, "
+                    "per stage",
+                    quantiles,
+                ),
+                MetricFamily(
+                    "keystone_serving_stage_queue_depth", "gauge",
+                    "staged-lane handoff queue depth, per stage",
+                    [
+                        Sample(
+                            "", {"engine": label, "stage": s}, d
+                        )
+                        for s, d in sorted(depths.items())
+                    ],
+                ),
+                MetricFamily(
+                    "keystone_serving_pipeline_windows_total", "counter",
+                    "windows fully delivered by the staged lane pipeline",
+                    [Sample("", {"engine": label}, m.windows.total)],
+                ),
+                MetricFamily(
+                    "keystone_serving_pipeline_bottleneck", "gauge",
+                    "1 on the stage with the lowest standalone rate "
+                    "(the lane's bottleneck attribution)",
+                    [
+                        Sample(
+                            "", {"engine": label, "stage": s},
+                            1.0 if bn and s == bn[0] else 0.0,
+                        )
+                        for s in sorted(m.stage_seconds)
+                    ],
+                ),
+                MetricFamily(
+                    "keystone_serving_pipeline_overlap_efficiency",
+                    "gauge",
+                    "sustained window rate over the bottleneck stage's "
+                    "standalone rate (~1.0 = nothing lost to "
+                    "serialization)",
+                    [Sample(
+                        "", {"engine": label},
+                        eff if eff is not None else 0.0,
+                    )],
+                ),
+            ]
+
         def collect():
             m = ref()
             if m is None or claims.get(label) is not ref:
                 return None  # engine gone or label re-claimed by a
                 # newer engine: prune this collector
-            return [
+            return stage_families(m) + [
                 MetricFamily(
                     "keystone_serving_compiles_total", "counter",
                     "XLA compiles per bucket",
@@ -282,8 +514,15 @@ class ServingMetrics:
                 ),
                 MetricFamily(
                     "keystone_serving_dispatch_latency_seconds", "summary",
-                    "engine dispatch wall time",
+                    "engine dispatch wall time, completion-timed at the "
+                    "caller's sync point",
                     quantile_samples(m.dispatch_latency),
+                ),
+                MetricFamily(
+                    "keystone_serving_dispatch_enqueue_seconds", "summary",
+                    "engine dispatch enqueue time (pad/placement + "
+                    "compiled-call dispatch, execution excluded)",
+                    quantile_samples(m.dispatch_enqueue_latency),
                 ),
                 MetricFamily(
                     "keystone_serving_request_latency_seconds", "summary",

@@ -150,8 +150,10 @@ def test_forced_swap_via_http_keeps_serving(served):
 
 def test_admit_span_parents_coalesce_dispatch_chain():
     """The gateway.admit span (client thread) parents the window's
-    microbatch.coalesce span (dispatcher thread), which parents
-    serving.dispatch — the full cross-thread chain in one trace."""
+    microbatch.coalesce span (dispatcher thread), which parents the
+    lane pipeline's per-stage spans (each on its own stage thread) —
+    the full cross-thread chain in one trace. With serial lanes
+    (pipeline_depth=0) the same chain ends in serving.dispatch."""
     tracer = enable_tracing()
     tracer.clear()
     try:
@@ -164,10 +166,25 @@ def test_admit_span_parents_coalesce_dispatch_chain():
         spans = {s.name: s for s in get_tracer().recent()}
         admit = spans["gateway.admit"]
         coalesce = spans["microbatch.coalesce"]
-        dispatch = spans["serving.dispatch"]
         assert coalesce.parent_id == admit.span_id
-        assert dispatch.parent_id == coalesce.span_id
+        for stage in ("host_prep", "upload", "compute", "deliver"):
+            stage_span = spans[f"pipeline.{stage}"]
+            assert stage_span.parent_id == coalesce.span_id
+            assert stage_span.trace_id == admit.trace_id
         assert admit.attrs["gateway"] == "span-gw"
+
+        # serial lanes keep the original admit -> coalesce ->
+        # serving.dispatch chain
+        tracer.clear()
+        with Gateway(
+            fitted, buckets=(4,), n_lanes=1, max_delay_ms=2.0,
+            warmup_example=np.zeros(D, np.float32),
+            name="span-gw-serial", pipeline_depth=0,
+        ) as gw:
+            gw.predict(batch(1, seed=54)[0]).result(timeout=30)
+        spans = {s.name: s for s in get_tracer().recent()}
+        dispatch = spans["serving.dispatch"]
+        assert dispatch.parent_id == spans["microbatch.coalesce"].span_id
     finally:
         disable_tracing()
         get_tracer().clear()
