@@ -19,8 +19,12 @@ cleanup() {
 trap cleanup EXIT
 
 # toy engine + admin endpoint on port 0 (ephemeral); writes the real
-# port to $PORT_FILE, serves a little traffic, then idles until killed
-JAX_PLATFORMS=cpu PYTHONPATH="$ROOT" python - "$PORT_FILE" >"$SERVER_LOG" 2>&1 <<'PY' &
+# port to $PORT_FILE, serves a little traffic, then idles until killed.
+# KEYSTONE_PEAK_* pin a fake hardware peak so the MFU gauge and the
+# roofline classification light up on the CPU backend too (unset, those
+# series are simply absent — the graceful-degradation contract).
+JAX_PLATFORMS=cpu KEYSTONE_PEAK_FLOPS=1e12 KEYSTONE_PEAK_MEMBW_GBPS=100 \
+    PYTHONPATH="$ROOT" python - "$PORT_FILE" >"$SERVER_LOG" 2>&1 <<'PY' &
 import sys, time
 import numpy as np
 from keystone_tpu.observability import enable_tracing, start_admin_server
@@ -30,6 +34,8 @@ enable_tracing()
 server = start_admin_server(port=0)
 fitted = build_pipeline(d=8, hidden=8, depth=2)
 engine = fitted.compiled(buckets=(4, 8), name="smoke")
+# warmup registers each bucket program's XLA cost model (flops/bytes)
+engine.warmup(example=np.zeros((8,), np.float32))
 rng = np.random.default_rng(0)
 engine.apply(rng.standard_normal((3, 8)).astype(np.float32), sync=True)
 engine.apply(rng.standard_normal((7, 8)).astype(np.float32), sync=True)
@@ -50,12 +56,14 @@ PORT="$(cat "$PORT_FILE")"
 BASE="http://127.0.0.1:$PORT"
 echo "admin endpoint up on $BASE"
 
-fetch() {  # fetch <url> — curl when present, stdlib urllib otherwise
+fetch() {  # fetch <url> [timeout_s] — curl when present, stdlib urllib otherwise
+    local timeout="${2:-10}"
     if command -v curl >/dev/null 2>&1; then
-        curl -fsS --max-time 10 "$1"
+        curl -fsS --max-time "$timeout" "$1"
     else
         python -c 'import sys, urllib.request; \
-sys.stdout.write(urllib.request.urlopen(sys.argv[1], timeout=10).read().decode())' "$1"
+sys.stdout.write(urllib.request.urlopen(sys.argv[1], timeout=float(sys.argv[2])).read().decode())' \
+            "$1" "$timeout"
     fi
 }
 
@@ -77,6 +85,31 @@ do
 done
 echo "PASS /metrics ($(grep -c '^keystone_' <<<"$METRICS") keystone series)"
 
+# device-truth plane: per-bucket cost models (flops/bytes from XLA's
+# cost analysis at warmup), goodput accounting, the MFU + roofline
+# series (lit by the pinned KEYSTONE_PEAK_* env), the detected-device
+# info gauge, and the memory sampler (host-RAM fallback on CPU)
+for want in \
+    'keystone_device_flops_per_dispatch{engine="smoke",bucket="4"}' \
+    'keystone_device_flops_per_dispatch{engine="smoke",bucket="8"}' \
+    'keystone_device_bytes_per_dispatch{engine="smoke",bucket="4"}' \
+    'keystone_serving_goodput_rows_total{engine="smoke",bucket="4"} 3' \
+    'keystone_serving_goodput_rows_total{engine="smoke",bucket="8"} 7' \
+    'keystone_serving_padded_rows_total{engine="smoke",bucket="4"} 1' \
+    'keystone_serving_padding_efficiency{engine="smoke"}' \
+    'keystone_serving_mfu{engine="smoke"}' \
+    'keystone_device_roofline_bound{engine="smoke",bucket="4",bound="' \
+    'keystone_serving_device_flops_total{engine="smoke"}' \
+    'keystone_device_info{kind="' \
+    'keystone_device_memory_bytes{device="host",kind="host-ram",stat="limit"}'
+do
+    grep -qF "$want" <<<"$METRICS" || {
+        echo "FAIL: /metrics missing device-truth series: $want"
+        echo "$METRICS" | grep -E 'keystone_(device|serving_(goodput|padd|mfu))' || true
+        exit 1; }
+done
+echo "PASS /metrics device-truth series (cost model, goodput, MFU, roofline, memory)"
+
 fetch "$BASE/tracez" | grep -q '"serving.dispatch"' || {
     echo "FAIL: /tracez has no serving.dispatch span"; exit 1; }
 echo "PASS /tracez"
@@ -87,14 +120,27 @@ fetch "$BASE/slz" | grep -q '"slos"' || {
     echo "FAIL: /slz did not render"; exit 1; }
 echo "PASS /slz"
 VARZ="$(fetch "$BASE/varz")"
-for want in '"build"' '"git_sha"' '"uptime_s"' '"jax_version"'; do
+for want in '"build"' '"git_sha"' '"uptime_s"' '"jax_version"' \
+    '"devices"' '"peak_flops"'; do
     grep -q "$want" <<<"$VARZ" || {
         echo "FAIL: /varz missing $want"; exit 1; }
 done
 fetch "$BASE/metrics" | grep -q '^keystone_build_info{' || {
     echo "FAIL: /metrics missing keystone_build_info"; exit 1; }
-echo "PASS /varz build info"
+echo "PASS /varz build info + device table"
 fetch "$BASE/debugz" | grep -q '"records"' || {
     echo "FAIL: /debugz did not render"; exit 1; }
 echo "PASS /debugz"
+
+# on-demand profiling: one /profilez capture returns a trace directory
+# listing (jax.profiler XPlane capture, CPU backend included)
+# first start_trace in a fresh process initializes the profiler
+# backend (~10s observed on this CPU image) — allow well beyond the
+# 1s capture window
+PROFILEZ="$(fetch "$BASE/profilez?seconds=1" 45)"
+grep -q '"trace_dir"' <<<"$PROFILEZ" || {
+    echo "FAIL: /profilez returned: $PROFILEZ"; exit 1; }
+grep -q '"file_count"' <<<"$PROFILEZ" || {
+    echo "FAIL: /profilez capture listed no files: $PROFILEZ"; exit 1; }
+echo "PASS /profilez (on-demand jax.profiler capture)"
 echo "smoke-admin: all checks passed"

@@ -25,7 +25,10 @@ trap cleanup EXIT
 # unmeetable latency SLO (0.1 ms) makes every request an injected-slow
 # request: burn gauges light up, the flight recorder captures span
 # trees, and the latency histogram carries trace_id exemplars.
-JAX_PLATFORMS=cpu PYTHONPATH="$ROOT" python - "$PORT_FILE" >"$SERVER_LOG" 2>&1 <<'PY' &
+# KEYSTONE_PEAK_* pin a fake hardware peak so MFU/roofline light up on
+# the CPU backend (absent without them — graceful degradation)
+JAX_PLATFORMS=cpu KEYSTONE_PEAK_FLOPS=1e12 KEYSTONE_PEAK_MEMBW_GBPS=100 \
+    PYTHONPATH="$ROOT" python - "$PORT_FILE" >"$SERVER_LOG" 2>&1 <<'PY' &
 import sys, time
 import jax.numpy as jnp
 from keystone_tpu.gateway import Gateway, GatewayServer
@@ -57,12 +60,14 @@ PORT="$(cat "$PORT_FILE")"
 BASE="http://127.0.0.1:$PORT"
 echo "gateway up on $BASE"
 
-fetch() {  # fetch <url> — curl when present, stdlib urllib otherwise
+fetch() {  # fetch <url> [timeout_s] — curl when present, stdlib urllib otherwise
+    local timeout="${2:-15}"
     if command -v curl >/dev/null 2>&1; then
-        curl -fsS --max-time 15 "$1"
+        curl -fsS --max-time "$timeout" "$1"
     else
         python -c 'import sys, urllib.request; \
-sys.stdout.write(urllib.request.urlopen(sys.argv[1], timeout=15).read().decode())' "$1"
+sys.stdout.write(urllib.request.urlopen(sys.argv[1], timeout=float(sys.argv[2])).read().decode())' \
+            "$1" "$timeout"
     fi
 }
 
@@ -142,6 +147,34 @@ do
         echo "$METRICS" | grep keystone_serving || true; exit 1; }
 done
 echo "PASS /metrics pipeline stage series"
+
+# device-truth plane on the GATEWAY port: per-bucket cost models from
+# each lane engine's warmup, live goodput/padding-efficiency, MFU +
+# roofline (pinned peaks), staging-buffer bytes from the lane pools,
+# the device info gauge, and the memory sampler the GatewayServer runs
+for want in \
+    'keystone_device_flops_per_dispatch{engine="smoke-lane0",bucket="4"}' \
+    'keystone_serving_goodput_rows_total{engine="smoke-lane0",bucket="' \
+    'keystone_serving_padding_efficiency{engine="smoke-lane0"}' \
+    'keystone_serving_mfu{engine="smoke-lane0"}' \
+    'keystone_device_roofline_bound{engine="smoke-lane0",bucket="4",bound="' \
+    'keystone_serving_staging_bytes{engine="smoke-lane0"}' \
+    'keystone_device_info{kind="' \
+    'keystone_device_memory_bytes{device="host",kind="host-ram",stat="limit"}'
+do
+    grep -qF "$want" <<<"$METRICS" || {
+        echo "FAIL: /metrics missing device-truth series: $want"
+        echo "$METRICS" | grep -E 'keystone_(device|serving_(goodput|padd|mfu|stag))' || true
+        exit 1; }
+done
+echo "PASS /metrics device-truth series (cost model, goodput, MFU, roofline, memory)"
+
+# on-demand profiling mirrored on the gateway port; first start_trace
+# initializes the profiler backend (~10s observed) — allow extra time
+PROFILEZ="$(fetch "$BASE/profilez?seconds=1" 45)"
+grep -q '"trace_dir"' <<<"$PROFILEZ" || {
+    echo "FAIL: /profilez returned: $PROFILEZ"; exit 1; }
+echo "PASS /profilez (on-demand jax.profiler capture while serving)"
 
 TRACEZ="$(fetch "$BASE/tracez")"
 for span in pipeline.host_prep pipeline.upload pipeline.compute \

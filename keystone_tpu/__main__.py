@@ -43,7 +43,7 @@ def main(argv=None) -> int:
         enable_tracing()
         server = start_admin_server(port=port)
         print(f"admin endpoint: {server.url()} "
-              "(/metrics /varz /healthz /tracez)", flush=True)
+              "(/metrics /varz /healthz /tracez /profilez)", flush=True)
     if "--otlp-endpoint" in argv:
         # OTLP/HTTP span export: every finished span batches to a
         # collector's /v1/traces on a background thread (stdlib urllib,
@@ -136,9 +136,12 @@ def main(argv=None) -> int:
         print("                   spans; add ?format=chrome for a"
               " Perfetto/chrome://tracing trace),")
         print("                   /slz (SLO burn rates), /debugz (flight"
-              " recorder). N=0 picks")
-        print("                   an ephemeral port. Off by default —"
-              " zero overhead when absent.")
+              " recorder), /profilez")
+        print("                   (on-demand jax.profiler capture of"
+              " ?seconds=N of live traffic).")
+        print("                   N=0 picks an ephemeral port. Off by"
+              " default — zero overhead when")
+        print("                   absent.")
         print("  --otlp-endpoint URL  export spans to an OTLP/HTTP"
               " collector (POST")
         print("                   URL/v1/traces, background batching,"

@@ -28,6 +28,14 @@ A stdlib ``http.server`` on a background daemon thread, following the
   the per-window ``microbatch.coalesce`` → ``pipeline.host_prep`` /
   ``.upload`` / ``.compute`` / ``.deliver`` stage chains when the
   lanes run pipelined and tracing is on).
+- ``GET /profilez?seconds=N`` — arm a ``jax.profiler`` trace around
+  the next N seconds of live traffic and list the capture directory
+  (Perfetto/XProf); 409 while another capture runs — mirrored from the
+  admin endpoint (``observability/profilez.py``) so a gateway-only
+  deployment can still grab a device trace. The server also runs the
+  device-memory sampler, so ``/metrics`` here carries the
+  ``keystone_device_memory_bytes`` and ``keystone_device_info``
+  families without an admin port.
 - ``POST /swap`` — force one lifecycle iteration
   (``Gateway.rebucket(force=True)``); returns the active bucket set.
   The smoke script's forced-swap drill.
@@ -54,7 +62,9 @@ import numpy as np
 
 from keystone_tpu.gateway.admission import Overloaded
 from keystone_tpu.gateway.lifecycle import Gateway
+from keystone_tpu.observability import device as device_obs
 from keystone_tpu.observability import flight as flight_mod
+from keystone_tpu.observability import profilez as profilez_mod
 from keystone_tpu.observability import prometheus
 from keystone_tpu.observability import slo as slo_mod
 from keystone_tpu.observability.httpd import BackgroundServer, JsonHandler
@@ -123,6 +133,12 @@ class _Handler(JsonHandler):
                     q.get("format", [""])[0],
                 )
                 self._send_json(doc, code=code, indent=1)
+            elif path == "/profilez":
+                q = parse_qs(url.query)
+                code, doc = profilez_mod.profilez_document(
+                    q.get("seconds", [None])[0]
+                )
+                self._send_json(doc, code=code, indent=1)
             elif path == "/tracez":
                 from keystone_tpu.observability.tracing import (
                     get_tracer,
@@ -142,7 +158,7 @@ class _Handler(JsonHandler):
                 self._send_text(
                     404,
                     "not found; try /predict /readyz /healthz /metrics "
-                    "/slz /debugz /tracez\n",
+                    "/slz /debugz /tracez /profilez\n",
                 )
         except Exception as e:
             logger.exception("gateway GET error for %s", self.path)
@@ -299,7 +315,7 @@ class _Handler(JsonHandler):
         self._send_json({"predictions": [p.tolist() for p in preds]})
 
 
-class GatewayServer(BackgroundServer):
+class GatewayServer(BackgroundServer, device_obs.MemorySamplerHost):
     """The inference frontend over one ``Gateway``. ``start()`` binds
     and serves on a daemon thread; ``stop()`` shuts the listener down
     (the gateway itself drains via ``Gateway.close``/``/drain``)."""
@@ -323,12 +339,26 @@ class GatewayServer(BackgroundServer):
         )
         self.input_dtype = np.dtype(input_dtype)
         self.request_log = bool(request_log)
+        # single-port deployments scrape THIS port: carry the device
+        # identity gauge and the memory sampler here too, same as the
+        # admin endpoint (refcounted — one thread per registry even
+        # when both servers run in one process)
+        device_obs.register_device_metrics(self.registry)
 
     def _configure(self, httpd) -> None:
         httpd.gateway = self.gateway
         httpd.registry = self.registry
         httpd.input_dtype = self.input_dtype
         httpd.request_log = self.request_log
+
+    def start(self) -> "GatewayServer":
+        super().start()
+        self._start_memory_sampler()
+        return self
+
+    def stop(self) -> None:
+        self._stop_memory_sampler()
+        super().stop()
 
 
 def main(argv=None) -> int:
@@ -417,7 +447,8 @@ def main(argv=None) -> int:
     ).start()
     print(
         f"gateway: {server.url()} (POST /predict, GET /readyz, "
-        "GET /metrics, GET /slz, GET /debugz, POST /swap, POST /drain)",
+        "GET /metrics, GET /slz, GET /debugz, GET /profilez, "
+        "POST /swap, POST /drain)",
         flush=True,
     )
     try:

@@ -53,7 +53,10 @@ from keystone_tpu.gateway.metrics import GatewayMetrics
 from keystone_tpu.gateway.pool import EnginePool
 from keystone_tpu.observability.flight import FlightRecorder
 from keystone_tpu.observability.slo import Slo, SloMonitor
-from keystone_tpu.serving.autoscale import suggest_buckets
+from keystone_tpu.serving.autoscale import (
+    predicted_efficiency,
+    suggest_buckets,
+)
 from keystone_tpu.serving.engine import DEFAULT_BUCKETS
 
 logger = logging.getLogger(__name__)
@@ -68,6 +71,10 @@ MIN_REBUCKET_OBSERVATIONS = 64
 SLO_SHED_BURN = 4.0
 SLO_SUSTAIN_SAMPLES = 2
 SLO_PRESSURE = 0.75
+
+
+def _fmt_eff(eff) -> str:
+    return f"{eff:.3f}" if eff is not None else "n/a"
 
 
 class Gateway:
@@ -218,6 +225,9 @@ class Gateway:
             flight=self.flight,
             forensic_threshold_s=slo_latency_s,
         )
+        # the last re-bucket's goodput audit (observed-before vs
+        # model-predicted-after padding efficiency); None until a swap
+        self.last_rebucket_audit: Optional[Dict] = None
         self._closed = False
         self._close_lock = threading.Lock()
         self._drained = threading.Event()
@@ -328,13 +338,37 @@ class Gateway:
                 merged[size] = merged.get(size, 0) + count
         return merged
 
+    def observed_goodput(self) -> Dict:
+        """Pool-wide LIVE goodput: valid vs padded rows every lane
+        engine actually dispatched (the device-truth counters the
+        padding-efficiency gauge exports per lane) — what a re-bucket
+        decision is audited against."""
+        goodput = padded = 0
+        for lane in self.pool.lanes:
+            m = lane.engine.metrics
+            goodput += m.examples.total
+            padded += m.padded_rows.total
+        total = goodput + padded
+        return {
+            "goodput_rows": goodput,
+            "padded_rows": padded,
+            "efficiency": goodput / total if total else None,
+        }
+
     def rebucket(self, force: bool = False) -> bool:
         """One autoscale iteration: histogram -> ``suggest_buckets`` ->
         build + warm replacements -> atomic swap -> old engines drain.
         Returns True when a swap happened. Unforced calls act only on
         enough evidence AND a changed proposal; ``force=True`` swaps
         unconditionally (same buckets if no better proposal — the smoke
-        path and swap drills use this)."""
+        path and swap drills use this).
+
+        Every swap is AUDITED: the observed goodput (live per-bucket
+        valid/padded counters) under the outgoing bucket set and the
+        model-predicted efficiency of the proposal are logged together
+        and kept at ``last_rebucket_audit``, so a ``suggest_buckets``
+        decision can be checked against what the traffic then actually
+        did (the next audit's observed number)."""
         with self._swap_lock:
             hist = self.observed_sizes()
             observations = sum(hist.values())
@@ -350,7 +384,29 @@ class Gateway:
                     return False
                 if proposal == self._buckets:
                     return False
+            observed = self.observed_goodput()
+            audit = {
+                "from_buckets": list(self._buckets),
+                "to_buckets": list(proposal),
+                "observations": observations,
+                "observed_efficiency_before": observed["efficiency"],
+                "goodput_rows_before": observed["goodput_rows"],
+                "padded_rows_before": observed["padded_rows"],
+                "predicted_efficiency_after": predicted_efficiency(
+                    hist, proposal
+                ),
+            }
             self.swap_engines(proposal)
+            self.last_rebucket_audit = audit
+            logger.info(
+                "gateway %s rebucket %s -> %s: observed padding "
+                "efficiency %s over %d goodput rows; proposal predicts "
+                "%s on the observed histogram",
+                self.name, audit["from_buckets"], audit["to_buckets"],
+                _fmt_eff(audit["observed_efficiency_before"]),
+                audit["goodput_rows_before"],
+                _fmt_eff(audit["predicted_efficiency_after"]),
+            )
             return True
 
     def swap_engines(self, buckets: Sequence[int]) -> None:

@@ -32,6 +32,13 @@ from keystone_tpu.observability.admin import (
     start_admin_server,
     stop_admin_server,
 )
+from keystone_tpu.observability.device import (
+    DeviceMemorySampler,
+    compiled_cost_model,
+    device_memory_stats,
+    device_table,
+    peaks_for,
+)
 from keystone_tpu.observability.flight import (
     FlightRecord,
     FlightRecorder,
@@ -59,6 +66,11 @@ from keystone_tpu.observability.tracing import (
 __all__ = [
     "AdminServer",
     "DEFAULT_HISTOGRAM_BUCKETS",
+    "DeviceMemorySampler",
+    "compiled_cost_model",
+    "device_memory_stats",
+    "device_table",
+    "peaks_for",
     "Exemplar",
     "FlightRecord",
     "FlightRecorder",

@@ -1,5 +1,5 @@
 """Admin HTTP endpoint: ``/metrics``, ``/varz``, ``/healthz``,
-``/tracez``, ``/slz``, ``/debugz``.
+``/tracez``, ``/slz``, ``/debugz``, ``/profilez``.
 
 Built on the shared scaffolding in ``observability/httpd.py`` — a
 stdlib ``http.server`` on a background daemon thread, nothing to
@@ -23,6 +23,17 @@ install, nothing running unless ``AdminServer.start()`` (or the
 - ``GET /debugz``   -> the flight recorders' tail-sampled forensic
   records (``?trace_id=`` filters to one request;
   ``&format=chrome`` dumps that request as a Chrome trace)
+- ``GET /profilez`` -> arm a ``jax.profiler`` trace around the next
+  ``?seconds=N`` of live traffic and list the capture directory
+  (Perfetto/XProf); one capture at a time — concurrent requests get
+  409 (``observability/profilez.py``)
+
+Starting the endpoint also starts the device-truth side of the plane:
+the detected device table rides in ``/varz``'s build block and as the
+``keystone_device_info`` gauge (cached one-time — no per-scrape
+``jax.devices()``), and the endpoint's ``DeviceMemorySampler`` publishes
+per-device in-use/peak/limit memory gauges
+(``observability/device.py``).
 
 Binding defaults to localhost; ``port=0`` picks an ephemeral port
 (``server.port`` reports the real one — tests and the smoke script use
@@ -39,7 +50,13 @@ import time
 from typing import Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
-from keystone_tpu.observability import flight, prometheus, slo
+from keystone_tpu.observability import (
+    device as device_obs,
+    flight,
+    profilez,
+    prometheus,
+    slo,
+)
 from keystone_tpu.observability.httpd import BackgroundServer, JsonHandler
 from keystone_tpu.observability.registry import (
     MetricsRegistry,
@@ -117,9 +134,12 @@ def _static_build_info() -> Dict:
 
 def build_info() -> Dict:
     """Who/what this process is: enough identity that two ``/varz``
-    scrapes of different binaries are distinguishable."""
+    scrapes of different binaries are distinguishable — plus the
+    detected device table (kind, count, peaks, HBM limit; cached
+    one-time exactly like the rest of the block)."""
     info = _static_build_info()
     info["uptime_s"] = round(time.time() - _PROCESS_START_S, 3)
+    info["devices"] = device_obs.device_table()
     return info
 
 
@@ -146,6 +166,7 @@ def register_build_metrics(registry: MetricsRegistry) -> None:
         lambda: _PROCESS_START_S,
         "process start time, unix epoch seconds",
     )
+    device_obs.register_device_metrics(registry)
 
 
 class _Handler(JsonHandler):
@@ -185,11 +206,17 @@ class _Handler(JsonHandler):
                     q.get("format", [""])[0],
                 )
                 self._send_json(doc, code=code, indent=1)
+            elif url.path == "/profilez":
+                q = parse_qs(url.query)
+                code, doc = profilez.profilez_document(
+                    q.get("seconds", [None])[0]
+                )
+                self._send_json(doc, code=code, indent=1)
             else:
                 self._send_text(
                     404,
                     "not found; try /metrics /varz /healthz /tracez "
-                    "/slz /debugz\n",
+                    "/slz /debugz /profilez\n",
                 )
         except Exception as e:  # a broken collector must not kill the
             # serving thread — report it to the scraper instead
@@ -197,7 +224,7 @@ class _Handler(JsonHandler):
             self._send_text(500, f"error: {e}\n")
 
 
-class AdminServer(BackgroundServer):
+class AdminServer(BackgroundServer, device_obs.MemorySamplerHost):
     """The background admin endpoint. ``start()`` binds and serves on a
     daemon thread; ``stop()`` shuts down cleanly. Usable as a context
     manager."""
@@ -220,6 +247,19 @@ class AdminServer(BackgroundServer):
     def _configure(self, httpd) -> None:
         httpd.registry = self.registry
         httpd.tracer = self.tracer
+
+    def start(self) -> "AdminServer":
+        # device memory telemetry rides with the endpoint: the sampler
+        # publishes per-device in-use/peak/limit gauges onto the same
+        # registry this endpoint scrapes (refcounted — a gateway in the
+        # same process shares the thread, not a second one)
+        super().start()
+        self._start_memory_sampler()
+        return self
+
+    def stop(self) -> None:
+        self._stop_memory_sampler()
+        super().stop()
 
 
 _server: Optional[AdminServer] = None

@@ -139,24 +139,25 @@ def _device_memory_limit() -> int:
     axon tunnel) fall back to 16 GiB (v5e); CPU backends without stats
     budget from HOST RAM instead — a flat 16 GiB there could drive the
     grouped-layout decision to OOM a small CPU host (ADVICE r4), and
-    ``layout='gathered'`` stays the manual escape hatch."""
+    ``layout='gathered'`` stays the manual escape hatch. The stats
+    probe itself is the shared None-guarded helper in
+    ``observability/device.py`` (one code path with auto_cache and the
+    memory telemetry gauges)."""
+    from keystone_tpu.observability.device import (
+        device_memory_stats,
+        host_memory_stats,
+    )
+
     dev = jax.devices()[0]
-    try:
-        stats = dev.memory_stats()
-        if stats and "bytes_limit" in stats:
-            return int(stats["bytes_limit"])
-    except Exception:
-        pass
+    stats = device_memory_stats(dev)
+    if stats and "bytes_limit" in stats:
+        return int(stats["bytes_limit"])
     if dev.platform == "cpu":
-        try:
-            with open("/proc/meminfo") as f:
-                for line in f:
-                    if line.startswith("MemAvailable:"):
-                        # budget a quarter of available RAM: the layout
-                        # copy competes with the data itself + the OS
-                        return int(line.split()[1]) * 1024 // 4
-        except OSError:
-            pass
+        host = host_memory_stats()
+        if host and "bytes_limit" in host and "bytes_in_use" in host:
+            # budget a quarter of available RAM: the layout copy
+            # competes with the data itself + the OS
+            return (host["bytes_limit"] - host["bytes_in_use"]) // 4
         return 4 * 1024**3
     return 16 * 1024**3
 

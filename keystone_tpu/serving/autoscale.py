@@ -20,6 +20,14 @@ by the largest bucket, typically ≤ a few hundred).
 Deployment loop: scrape sizes (``/metrics`` exports them as
 ``keystone_serving_request_size_total``), call ``suggest_buckets``,
 build a fresh ``CompiledPipeline`` with the proposal, warm it, swap.
+
+``padding_waste`` is the OFFLINE model; the live truth is the
+per-bucket goodput accounting every dispatch records
+(``keystone_serving_goodput_rows_total`` / ``padded_rows_total`` and
+the ``padding_efficiency`` gauge, serving/metrics.py).
+``predicted_efficiency`` bridges the two so the gateway can log
+model-vs-observed at each re-bucket and the bench can assert they
+agree.
 """
 
 from __future__ import annotations
@@ -53,6 +61,23 @@ def padding_waste(hist: Histogram, buckets: Sequence[int]) -> int:
             covering = next(b for b in buckets if tail <= b)
             waste += (covering - tail) * count
     return waste
+
+
+def predicted_efficiency(
+    source: Union[ServingMetrics, Histogram], buckets: Sequence[int]
+) -> Optional[float]:
+    """The padding efficiency (valid rows over all rows shipped) the
+    ``padding_waste`` model PREDICTS for serving ``source``'s histogram
+    through ``buckets`` — the offline counterpart of the live
+    ``ServingMetrics.padding_efficiency`` gauge, which is what makes
+    ``suggest_buckets`` decisions auditable: the gateway logs observed
+    efficiency next to this prediction at every re-bucket. None on an
+    empty histogram."""
+    hist = _histogram_of(source)
+    valid = sum(size * count for size, count in hist.items())
+    if not valid:
+        return None
+    return valid / (valid + padding_waste(hist, buckets))
 
 
 def suggest_buckets(

@@ -103,6 +103,10 @@ class MicroBatcher:
             LanePipeline(
                 self._assemble, depth=self.pipeline_depth,
                 name=engine.name,
+                # gauge the pool on whichever engine currently serves
+                # the lane, so windows that outlive a swap don't stamp
+                # the new pool's footprint onto a retired engine
+                current_metrics=lambda: self.metrics,
             )
             if self.pipeline_depth > 0 else None
         )
@@ -188,6 +192,18 @@ class MicroBatcher:
                 # for the old bucket set; in-flight windows keep their
                 # coalesce-time engine and finish on it
                 self._pipeline.on_swap()
+                # reset() dropped all pool accounting — push the zeroed
+                # footprint so the gauge mirrors the pool immediately
+                # instead of holding the pre-swap value until the next
+                # window acquires a buffer. Ordering contract with
+                # publish_staging_bytes: self.metrics was reassigned
+                # BEFORE the reset and these stamps run AFTER it, so a
+                # stage thread publishing under the pool lock can never
+                # leave the retired engine carrying post-swap bytes
+                old.metrics.set_staging_bytes(0)
+                engine.metrics.set_staging_bytes(
+                    self._pipeline.pool.staging_bytes
+                )
             self._cond.notify()
         return old
 

@@ -29,12 +29,24 @@
   per-stage standalone rates, bottleneck attribution, and
   ``overlap_efficiency`` mirroring ``bench_imagenet_stream_featurize``'s
   model (one-sided ``>= 0.8`` assert; outputs bit-identical asserted).
+- ``serving_goodput_mfu`` — device-truth accounting under mixed-size
+  traffic: measured padding efficiency off the live per-bucket goodput
+  counters, asserted against the ``padding_waste`` model's prediction
+  for the same observed histogram (the offline estimate the live
+  counters supersede must agree with reality), plus modeled device
+  FLOPs, the rolling MFU gauge, and each bucket's roofline class where
+  hardware peaks are known (``KEYSTONE_PEAK_FLOPS`` /
+  ``KEYSTONE_PEAK_MEMBW_GBPS`` override for unlisted hardware; without
+  peaks those fields report null — never fabricated zeros).
 
 Callable standalone (``python -m keystone_tpu serve-bench``) or from
 the repo-level ``bench.py`` which passes its own ``emit`` so rows land
 in the round's BENCH JSON with ``vs_baseline`` wiring (null for now —
 the reference published no serving numbers; the field exists so future
-rounds can ratio against THESE rows).
+rounds can ratio against THESE rows). ``--profile-dir DIR`` wraps the
+whole run in a ``jax.profiler`` trace (``utils/profiling.trace``), so
+any existing row can be captured for Perfetto/XProf without code
+edits.
 """
 
 from __future__ import annotations
@@ -520,6 +532,67 @@ def bench_pipeline_overlap(
     )
 
 
+def bench_goodput_mfu(
+    emit, fitted, buckets: Sequence[int], d: int, passes: int = 2
+) -> None:
+    """``serving_goodput_mfu`` — drive a mixed-size sweep and read the
+    device-truth plane back: measured padding efficiency (live
+    per-bucket goodput/padded counters), modeled FLOPs + rolling MFU,
+    and the roofline class per bucket. The acceptance assert is
+    measured efficiency >= the ``padding_waste``-model prediction for
+    the same observed histogram minus tolerance — the live counters
+    are the ground truth the offline estimate must agree with."""
+    from keystone_tpu.serving.autoscale import predicted_efficiency
+
+    engine = fitted.compiled(buckets=buckets)
+    engine.warmup(example=jnp.zeros((d,), jnp.float32))
+    rng = np.random.default_rng(7)
+    mb = engine.max_bucket
+    sizes = sorted(
+        set(int(s) for s in rng.integers(1, mb + 1, 16)) | {1, mb}
+    )
+    xs = {
+        n: rng.standard_normal((n, d)).astype(np.float32) for n in sizes
+    }
+    for _ in range(passes):
+        for x in xs.values():
+            engine.apply(x, sync=True)
+    m = engine.metrics
+    measured = m.padding_efficiency()
+    predicted = predicted_efficiency(
+        m.request_sizes.snapshot(), engine.buckets
+    )
+    assert measured is not None, "no dispatches recorded"
+    assert predicted is not None, "no request-size histogram"
+    assert measured >= predicted - 0.02, (
+        f"measured padding efficiency {measured:.4f} fell below the "
+        f"padding_waste-model prediction {predicted:.4f} — the live "
+        f"goodput counters and the offline model disagree"
+    )
+    mfu = m.mfu()
+    cost_model_buckets = sorted(m.cost_models)
+    emit(
+        "serving_goodput_mfu", measured, "padding_efficiency",
+        extra={
+            "predicted_efficiency": round(predicted, 4),
+            "goodput_rows": m.examples.total,
+            "padded_rows": m.padded_rows.total,
+            "distinct_batch_sizes": len(xs),
+            "buckets": list(engine.buckets),
+            "device_flops_total": m.device_flops.total,
+            "flops_per_dispatch": {
+                str(b): m.cost_models[b].get("flops")
+                for b in cost_model_buckets
+            },
+            "mfu": round(mfu, 8) if mfu is not None else None,
+            "roofline": {
+                str(b): m.roofline_bound(b) for b in engine.buckets
+            },
+            "cost_analysis_available": bool(cost_model_buckets),
+        },
+    )
+
+
 def run_serving_benches(
     emit,
     d: int = 256,
@@ -534,6 +607,7 @@ def run_serving_benches(
     bench_gateway(emit, fitted, buckets, d)
     bench_swap_blip(emit, fitted, buckets, d)
     bench_pipeline_overlap(emit, fitted, buckets, d)
+    bench_goodput_mfu(emit, fitted, buckets, d)
 
 
 def main(argv=None) -> int:
@@ -554,6 +628,11 @@ def main(argv=None) -> int:
                     help="number of matmul nodes in the bench pipeline")
     ap.add_argument("--no-cache", action="store_true",
                     help="skip persistent-compile-cache setup")
+    ap.add_argument("--profile-dir", default=None, metavar="DIR",
+                    help="wrap the whole bench run in a jax.profiler "
+                    "trace written to DIR (open in Perfetto or "
+                    "TensorBoard's XProf plugin) — any row can be "
+                    "profiled without code edits")
     args = ap.parse_args(argv)
     if not args.no_cache:
         setup_compilation_cache()
@@ -570,8 +649,20 @@ def main(argv=None) -> int:
             row.update(extra)
         print(json.dumps(row), flush=True)
 
-    run_serving_benches(
-        emit, d=args.d, hidden=args.hidden, depth=args.depth,
-        buckets=buckets,
-    )
+    def run():
+        run_serving_benches(
+            emit, d=args.d, hidden=args.hidden, depth=args.depth,
+            buckets=buckets,
+        )
+
+    if args.profile_dir:
+        from keystone_tpu.utils.profiling import trace
+
+        with trace(args.profile_dir):
+            run()
+        print(
+            json.dumps({"profile_dir": args.profile_dir}), flush=True
+        )
+    else:
+        run()
     return 0

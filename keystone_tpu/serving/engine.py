@@ -34,6 +34,7 @@ passed with that promise.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -42,10 +43,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from keystone_tpu.observability import device as device_obs
 from keystone_tpu.observability.tracing import get_tracer
 from keystone_tpu.parallel import mesh as mesh_lib
 from keystone_tpu.parallel.dataset import Dataset, _leading_dim
 from keystone_tpu.serving.metrics import ServingMetrics
+
+logger = logging.getLogger(__name__)
 
 DEFAULT_BUCKETS = (8, 64, 512)
 
@@ -104,6 +108,21 @@ class CompiledPipeline:
         # MetricsRegistry (weakref bridge — registration never extends
         # this engine's lifetime) under the `engine` label
         self.name = self.metrics.register(engine=name)
+        # device truth for the MFU/roofline series: detected peaks of
+        # the local device kind (None on unknown hardware -> those
+        # series stay absent) scaled by the engine's device count
+        devices = device_obs.device_table()
+        peak_flops, peak_membw = (
+            (devices[0]["peak_flops"],
+             devices[0]["peak_membw_bytes_per_s"])
+            if devices else (None, None)
+        )
+        n_devices = 1
+        if self.shard and self.mesh is not None:
+            n_devices = int(getattr(self.mesh.devices, "size", 1))
+        self.metrics.set_device_peaks(
+            peak_flops, peak_membw, n_devices=n_devices
+        )
         self.donate = donate and jax.default_backend() in ("tpu", "gpu")
         self._fns: Dict[int, Callable] = {}
         # a MicroBatcher worker and direct apply() callers may race to
@@ -344,10 +363,46 @@ class CompiledPipeline:
             zeros = treedef.unflatten(
                 [jnp.zeros((b,) + s, d) for s, d in specs]
             )
+            fn = self._fn(b)
+            staged = self._stage(zeros, b, b, owned=True)
+            # outside the timed window: the returned numbers are the
+            # dispatch's compile wall, not cost-model extraction
+            self._register_cost_model(b, fn, staged)
             t0 = time.perf_counter()
-            out = self._fn(b)(self._stage(zeros, b, b, owned=True))
+            out = fn(staged)
             jax.block_until_ready(out)
             times[b] = time.perf_counter() - t0
         return times
+
+    def _register_cost_model(self, bucket: int, fn, staged) -> None:
+        """Pull the bucket program's static XLA cost model — FLOPs,
+        bytes accessed — and register it on the metrics (the
+        MFU/roofline/goodput input).
+
+        Reads ``fn.lower(staged).cost_analysis()``: lowering shares the
+        jit TRACE cache (the compile-count contract holds, and the
+        ``fn(staged)`` dispatch that follows retraces nothing) and the
+        analysis runs on the lowered module — no XLA compile. The AOT
+        *executable* cache is NOT shared with the jit dispatch path
+        (measured: an ``lower().compile()`` here would compile every
+        bucket twice), so ``memory_analysis()`` (temp HBM) is pulled
+        only when the persistent compilation cache is configured — the
+        dispatch's own compile then replays from disk instead of
+        paying the program twice. Best-effort by design: backends
+        whose lowering or analyses fail (or report nothing) leave the
+        model ABSENT — serving and the scrape surface must work
+        identically without it."""
+        try:
+            lowered = fn.lower(staged)
+            model = device_obs.compiled_cost_model(lowered)
+            if getattr(jax.config, "jax_compilation_cache_dir", None):
+                model.update(
+                    device_obs.compiled_cost_model(lowered.compile())
+                )
+            self.metrics.set_cost_model(bucket, model)
+        except Exception:
+            logger.debug(
+                "no AOT cost analysis for bucket %d", bucket, exc_info=True
+            )
 
     __call__ = apply

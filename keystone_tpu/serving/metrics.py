@@ -7,6 +7,20 @@ counts, padded-vs-valid example counts (padding waste), the observed
 per-request size histogram (what the bucket autoscaler reads), dispatch
 and end-to-end request latency percentiles, and a queue-depth gauge.
 
+Device truth rides on the same instance: ``CompiledPipeline.warmup``
+registers each bucket program's XLA cost model (``set_cost_model``:
+FLOPs, bytes accessed, temp HBM from ``lower().compile()``'s
+``cost_analysis``/``memory_analysis``), and every dispatch then
+attributes *modeled device work* — goodput rows vs padded rows per
+bucket, modeled FLOPs — to the traffic that caused it. Combined with
+the detected per-device-kind peaks (``observability/device.py``,
+injected via ``set_device_peaks``) that yields the rolling **MFU**
+gauge (modeled FLOP/s over peak FLOP/s, the PaLM-report convention)
+and a per-bucket **roofline** classification (arithmetic intensity vs
+the device's FLOPs/byte ridge point: compute-bound or
+bandwidth-bound). Backends that report no cost analysis degrade to
+ABSENT series — never zeros, never errors (the CPU CI contract).
+
 Pipelined-lane serving (``serving/pipeline.py``) adds per-stage series:
 a seconds recorder per stage (``host_prep``/``upload``/``compute``/
 ``deliver``), per-stage handoff-queue depth gauges, a windows-completed
@@ -55,9 +69,26 @@ class ServingMetrics:
         self.compiles = Counter()
         # bucket -> number of compiled-program dispatches
         self.dispatches = Counter()
-        # valid examples served / padded rows shipped (waste tracking)
+        # goodput accounting, PER BUCKET: valid examples served vs
+        # padded rows shipped (cells keyed by bucket; ``.total`` is the
+        # engine-wide number the summary/bench read)
         self.examples = Counter()
         self.padded_rows = Counter()
+        # bucket -> static XLA cost model ({flops, bytes_accessed,
+        # temp_bytes, ...}), registered once at warmup by
+        # CompiledPipeline; absent on backends without cost analysis
+        self.cost_models: Dict[int, Dict[str, float]] = {}
+        # modeled device FLOPs dispatched (lifetime; absent until a
+        # cost model exists for a dispatched bucket)
+        self.device_flops = Counter()
+        # detected device peaks (observability/device.py); None =
+        # unknown hardware -> MFU/roofline series stay absent
+        self._peak_flops: Optional[float] = None
+        self._peak_membw: Optional[float] = None
+        self._n_devices: int = 1
+        # live host staging-buffer bytes (HostBufferPool); None until a
+        # pipelined lane runs
+        self._staging_bytes: Optional[int] = None
         # valid-row count of each dispatch (the observed request-size
         # histogram serving/autoscale.py proposes bucket sets from)
         self.request_sizes = Counter()
@@ -87,10 +118,13 @@ class ServingMetrics:
         self.request_latency = LatencyRecorder(latency_window)
         self._queue_depth = 0
         self._coalesced_max = 0
-        # (timestamp, examples) per dispatch, pruned to the rate window:
-        # the windowed examples/sec gauge reads this, so idle periods
-        # decay to zero instead of diluting a lifetime average
-        self._rate_events: Deque[Tuple[float, int]] = collections.deque()
+        # (timestamp, valid, padded, modeled flops) per dispatch,
+        # pruned to the rate window: the windowed examples/sec,
+        # padding-efficiency, and MFU gauges all read this, so idle
+        # periods decay to zero instead of diluting a lifetime average
+        self._rate_events: Deque[
+            Tuple[float, int, int, float]
+        ] = collections.deque()
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
 
@@ -107,15 +141,21 @@ class ServingMetrics:
         feeds ``dispatch_latency`` directly (callers that only know the
         enqueue time use ``record_dispatch_enqueue`` and record the
         completion number at their sync point)."""
+        padded = bucket - n_valid
         self.dispatches.inc(bucket)
-        self.examples.inc(None, n_valid)
-        self.padded_rows.inc(None, bucket - n_valid)
+        self.examples.inc(bucket, n_valid)
+        self.padded_rows.inc(bucket, padded)
         self.request_sizes.inc(n_valid)
+        # modeled device work for this dispatch: the bucket program's
+        # static cost is paid whether rows are valid or padding
+        flops = self.cost_models.get(bucket, {}).get("flops", 0.0)
+        if flops:
+            self.device_flops.inc(None, flops)
         if seconds is not None:
             self.dispatch_latency.record(seconds)
         now = time.perf_counter()
         with self._lock:
-            self._rate_events.append((now, n_valid))
+            self._rate_events.append((now, n_valid, padded, flops))
             cutoff = now - RATE_WINDOW_S
             while self._rate_events and self._rate_events[0][0] < cutoff:
                 self._rate_events.popleft()
@@ -128,6 +168,34 @@ class ServingMetrics:
         """Completion-timed dispatch wall time, recorded at the sync
         point where the dispatched results became ready."""
         self.dispatch_latency.record(seconds)
+
+    # -- device-truth hooks (engine warmup / observability.device) ---------
+
+    def set_cost_model(self, bucket: int, model: Dict[str, float]) -> None:
+        """Register one bucket program's static XLA cost model
+        (``CompiledPipeline.warmup`` calls this with the normalized
+        ``cost_analysis``/``memory_analysis`` output). Empty models are
+        dropped — absence of cost analysis must yield absent series."""
+        if model:
+            self.cost_models[int(bucket)] = dict(model)
+
+    def set_device_peaks(
+        self,
+        peak_flops: Optional[float],
+        peak_membw: Optional[float] = None,
+        n_devices: int = 1,
+    ) -> None:
+        """Detected hardware peaks (``observability/device.peaks_for``)
+        — the MFU denominator and the roofline ridge point. None means
+        unknown hardware: the derived series stay absent."""
+        self._peak_flops = peak_flops
+        self._peak_membw = peak_membw
+        self._n_devices = max(1, int(n_devices))
+
+    def set_staging_bytes(self, nbytes: int) -> None:
+        """Live host staging-buffer footprint (``HostBufferPool``)."""
+        with self._lock:
+            self._staging_bytes = int(nbytes)
 
     # -- pipeline-side hooks (serving/pipeline.py) -------------------------
 
@@ -193,9 +261,76 @@ class ServingMetrics:
         cutoff = now - window
         with self._lock:
             served = sum(
-                n for t, n in self._rate_events if t >= cutoff
+                ev[1] for ev in self._rate_events if ev[0] >= cutoff
             )
         return served / window
+
+    def padding_efficiency(
+        self, window: float = RATE_WINDOW_S
+    ) -> Optional[float]:
+        """Windowed goodput fraction: valid rows over all rows shipped
+        (valid + padding) across the dispatches of the last ``window``
+        seconds. The LIVE counterpart of the offline
+        ``autoscale.padding_waste`` estimate — what actually went over
+        the wire, not what the histogram model predicts. None with no
+        dispatches in the window (absent gauge, not a fake 1.0)."""
+        now = time.perf_counter()
+        window = min(window, RATE_WINDOW_S, max(now - self._t0, 1e-9))
+        cutoff = now - window
+        with self._lock:
+            valid = padded = 0
+            for ev in self._rate_events:
+                if ev[0] >= cutoff:
+                    valid += ev[1]
+                    padded += ev[2]
+        total = valid + padded
+        return valid / total if total else None
+
+    def flops_per_sec(self, window: float = RATE_WINDOW_S) -> float:
+        """Windowed modeled device FLOP/s (zero until a dispatched
+        bucket has a registered cost model)."""
+        now = time.perf_counter()
+        window = min(window, RATE_WINDOW_S, max(now - self._t0, 1e-9))
+        cutoff = now - window
+        with self._lock:
+            flops = sum(
+                ev[3] for ev in self._rate_events if ev[0] >= cutoff
+            )
+        return flops / window
+
+    def mfu(self, window: float = RATE_WINDOW_S) -> Optional[float]:
+        """Rolling model FLOPs utilization: windowed modeled FLOP/s
+        over the device set's peak FLOP/s (the PaLM-report convention).
+        None when the hardware peak is unknown or no dispatched bucket
+        carries a cost model — absent series, never a made-up zero."""
+        if not self._peak_flops or not self.cost_models:
+            return None
+        return self.flops_per_sec(window) / (
+            self._peak_flops * self._n_devices
+        )
+
+    def roofline_bound(self, bucket: int) -> Optional[str]:
+        """``"compute"`` or ``"bandwidth"`` for one bucket program:
+        arithmetic intensity (modeled FLOPs per byte accessed) above or
+        below the device's ridge point (peak FLOP/s over peak memory
+        bandwidth). None without a cost model or known peaks."""
+        model = self.cost_models.get(bucket)
+        if (
+            not model
+            or not self._peak_flops
+            or not self._peak_membw
+            or not model.get("bytes_accessed")
+            or "flops" not in model
+        ):
+            return None
+        intensity = model["flops"] / model["bytes_accessed"]
+        ridge = self._peak_flops / self._peak_membw
+        return "compute" if intensity >= ridge else "bandwidth"
+
+    @property
+    def staging_bytes(self) -> Optional[int]:
+        with self._lock:
+            return self._staging_bytes
 
     # -- pipeline attribution (the streaming bench's model, per lane) ------
 
@@ -293,6 +428,8 @@ class ServingMetrics:
         enqueue = self.dispatch_enqueue_latency.snapshot()
         request = self.request_latency.snapshot()
         pipeline = self.pipeline_report()
+        eff = self.padding_efficiency()
+        mfu = self.mfu()
         out = {
             "compiles_per_bucket": {
                 str(k): v for k, v in sorted(self.compiles.snapshot().items())
@@ -303,6 +440,11 @@ class ServingMetrics:
             },
             "examples": self.examples.total,
             "padded_rows": self.padded_rows.total,
+            "padding_efficiency": (
+                round(eff, 4) if eff is not None else None
+            ),
+            "device_flops_total": self.device_flops.total,
+            "mfu": round(mfu, 6) if mfu is not None else None,
             "examples_per_sec": round(self.examples_per_sec(), 1),
             "examples_per_sec_lifetime": round(
                 self.examples_per_sec_lifetime(), 1
@@ -462,12 +604,102 @@ class ServingMetrics:
                 ),
             ]
 
+        def device_families(m):
+            """Device-truth families — static cost models, rolling MFU,
+            roofline classification, goodput. Every family is emitted
+            only when its inputs exist (cost analysis present, peaks
+            known, pool live): a backend that reports nothing yields
+            ABSENT series, the graceful-degradation contract."""
+            fams = []
+            models = dict(m.cost_models)
+            if models:
+                per_key = (
+                    ("flops", "keystone_device_flops_per_dispatch",
+                     "modeled XLA FLOPs per dispatch of the bucket's "
+                     "compiled program"),
+                    ("bytes_accessed", "keystone_device_bytes_per_dispatch",
+                     "modeled bytes accessed per dispatch of the "
+                     "bucket's compiled program"),
+                    ("temp_bytes", "keystone_device_temp_hbm_bytes",
+                     "temp (scratch) device memory of the bucket's "
+                     "compiled program"),
+                )
+                for key, name, help_ in per_key:
+                    samples = [
+                        Sample(
+                            "", {"engine": label, "bucket": str(b)},
+                            mod[key],
+                        )
+                        for b, mod in sorted(models.items())
+                        if key in mod
+                    ]
+                    if samples:
+                        fams.append(
+                            MetricFamily(name, "gauge", help_, samples)
+                        )
+                roofline = [
+                    (b, m.roofline_bound(b)) for b in sorted(models)
+                ]
+                roofline = [(b, r) for b, r in roofline if r is not None]
+                if roofline:
+                    fams.append(MetricFamily(
+                        "keystone_device_roofline_bound", "gauge",
+                        "1 on the bucket program's roofline side "
+                        "(arithmetic intensity vs the device ridge "
+                        "point): compute- or bandwidth-bound",
+                        [
+                            Sample(
+                                "",
+                                {
+                                    "engine": label,
+                                    "bucket": str(b),
+                                    "bound": side,
+                                },
+                                1.0 if side == r else 0.0,
+                            )
+                            for b, r in roofline
+                            for side in ("compute", "bandwidth")
+                        ],
+                    ))
+            if m.device_flops.total:
+                fams.append(MetricFamily(
+                    "keystone_serving_device_flops_total", "counter",
+                    "modeled device FLOPs dispatched (per the buckets' "
+                    "static cost models)",
+                    [Sample("", {"engine": label}, m.device_flops.total)],
+                ))
+            mfu = m.mfu()
+            if mfu is not None:
+                fams.append(MetricFamily(
+                    "keystone_serving_mfu", "gauge",
+                    "rolling model FLOPs utilization: windowed modeled "
+                    "FLOP/s over detected peak FLOP/s",
+                    [Sample("", {"engine": label}, mfu)],
+                ))
+            eff = m.padding_efficiency()
+            if eff is not None:
+                fams.append(MetricFamily(
+                    "keystone_serving_padding_efficiency", "gauge",
+                    "windowed goodput fraction: valid rows over all "
+                    "rows shipped (valid + padding)",
+                    [Sample("", {"engine": label}, eff)],
+                ))
+            staging = m.staging_bytes
+            if staging is not None:
+                fams.append(MetricFamily(
+                    "keystone_serving_staging_bytes", "gauge",
+                    "live host staging-buffer bytes held by the lane's "
+                    "buffer pool (pooled + in flight)",
+                    [Sample("", {"engine": label}, staging)],
+                ))
+            return fams
+
         def collect():
             m = ref()
             if m is None or claims.get(label) is not ref:
                 return None  # engine gone or label re-claimed by a
                 # newer engine: prune this collector
-            return stage_families(m) + [
+            return stage_families(m) + device_families(m) + [
                 MetricFamily(
                     "keystone_serving_compiles_total", "counter",
                     "XLA compiles per bucket",
@@ -490,9 +722,20 @@ class ServingMetrics:
                     [Sample("", {"engine": label}, m.examples.total)],
                 ),
                 MetricFamily(
+                    "keystone_serving_goodput_rows_total", "counter",
+                    "valid (non-padding) rows dispatched, per bucket",
+                    [
+                        Sample("", {"engine": label, "bucket": str(b)}, v)
+                        for b, v in sorted(m.examples.snapshot().items())
+                    ],
+                ),
+                MetricFamily(
                     "keystone_serving_padded_rows_total", "counter",
-                    "padded rows shipped (bucket waste)",
-                    [Sample("", {"engine": label}, m.padded_rows.total)],
+                    "padded rows shipped (bucket waste), per bucket",
+                    [
+                        Sample("", {"engine": label, "bucket": str(b)}, v)
+                        for b, v in sorted(m.padded_rows.snapshot().items())
+                    ],
                 ),
                 MetricFamily(
                     "keystone_serving_request_size_total", "counter",

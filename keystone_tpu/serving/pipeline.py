@@ -93,7 +93,36 @@ class HostBufferPool:
         self.generation = 0
         self.allocations = 0
         self._free: Dict[Any, List[Any]] = {}
+        # live staging footprint: bytes sitting free in the pool +
+        # bytes riding in-flight windows (the
+        # ``keystone_serving_staging_bytes`` gauge input)
+        self._pooled_bytes = 0
+        self._outstanding_bytes = 0
+        # a key pins (bucket, treedef, shapes, dtypes), so its buffer
+        # size is a constant — computed once per key, not per window
+        self._key_bytes: Dict[Any, int] = {}
         self._lock = threading.Lock()
+
+    @staticmethod
+    def _tree_bytes(buffers: Any) -> int:
+        return sum(
+            int(getattr(a, "nbytes", 0))
+            for a in jax.tree_util.tree_leaves(buffers)
+        )
+
+    def _bytes_for(self, key: Any, buffers: Any) -> int:
+        """Cached per-key buffer size (caller holds ``self._lock``)."""
+        nbytes = self._key_bytes.get(key)
+        if nbytes is None:
+            nbytes = self._key_bytes[key] = self._tree_bytes(buffers)
+        return nbytes
+
+    @property
+    def staging_bytes(self) -> int:
+        """Total host bytes the pool currently accounts for (pooled
+        free buffers + buffers riding in-flight windows)."""
+        with self._lock:
+            return self._pooled_bytes + self._outstanding_bytes
 
     def reset(self) -> None:
         """Engine swap: drop every pooled buffer and invalidate
@@ -101,6 +130,11 @@ class HostBufferPool:
         with self._lock:
             self.generation += 1
             self._free.clear()
+            self._key_bytes.clear()  # keys are cut per bucket set
+            # old-generation buffers still in flight stop being
+            # accounted here — their release is a drop, not a return
+            self._pooled_bytes = 0
+            self._outstanding_bytes = 0
 
     def acquire(
         self, key: Any, alloc: Callable[[], Any]
@@ -108,20 +142,45 @@ class HostBufferPool:
         with self._lock:
             free = self._free.get(key)
             if free:
-                return self.generation, free.pop()
+                buffers = free.pop()
+                nbytes = self._bytes_for(key, buffers)
+                self._pooled_bytes -= nbytes
+                self._outstanding_bytes += nbytes
+                return self.generation, buffers
             self.allocations += 1
             gen = self.generation
-        return gen, alloc()
+        buffers = alloc()
+        with self._lock:
+            if gen == self.generation:
+                self._outstanding_bytes += self._bytes_for(key, buffers)
+        return gen, buffers
+
+    def publish_staging_bytes(self, resolve_metrics: Callable[[], Any]) -> None:
+        """Stamp the live footprint on ``resolve_metrics()``'s gauge,
+        atomically with ``reset()``: a swap reassigns the batcher's
+        current metrics BEFORE it resets this pool, and re-stamps both
+        gauges AFTER, so a stage thread that selects its target and
+        publishes while holding this lock can never leave a retired
+        engine carrying the new pool's bytes."""
+        with self._lock:
+            resolve_metrics().set_staging_bytes(
+                self._pooled_bytes + self._outstanding_bytes
+            )
 
     def release(self, key: Any, generation: int, buffers: Any) -> None:
         if buffers is None:
             return  # window died before its buffers were attached
         with self._lock:
             if generation != self.generation:
-                return  # cut for a retired engine's buckets: drop
+                # cut for a retired engine's buckets: drop (reset()
+                # already zeroed their outstanding-byte accounting)
+                return
+            nbytes = self._bytes_for(key, buffers)
+            self._outstanding_bytes -= nbytes
             free = self._free.setdefault(key, [])
             if len(free) < self.max_per_key:
                 free.append(buffers)
+                self._pooled_bytes += nbytes
 
 
 def resolve_window_futures(metrics, valid, futures, enqueued) -> None:
@@ -199,12 +258,18 @@ class LanePipeline:
         assemble: Callable[[List[Any]], Tuple[Any, bool]],
         depth: int = DEFAULT_DEPTH,
         name: str = "lane",
+        current_metrics: Optional[Callable[[], Any]] = None,
     ):
         if depth < 1:
             raise ValueError(f"pipeline depth must be >= 1, got {depth}")
         self.depth = depth
         self.name = name
         self._assemble = assemble
+        # the staging pool belongs to the LANE, so its byte gauge
+        # tracks the engine currently serving it — a window that
+        # outlives a swap must not stamp the new pool's footprint onto
+        # its retired coalesce-time engine (double-counted series)
+        self._current_metrics = current_metrics
         self.pool = HostBufferPool(max_per_key=depth + 1)
         self._queues: Dict[str, "queue.Queue"] = {
             s: queue.Queue(maxsize=depth) for s in self.STAGES
@@ -221,6 +286,13 @@ class LanePipeline:
         ]
         for t in self._threads:
             t.start()
+
+    def _publish_staging_bytes(self, fallback_engine) -> None:
+        resolve = self._current_metrics
+        self.pool.publish_staging_bytes(
+            resolve if resolve is not None
+            else lambda: fallback_engine.metrics
+        )
 
     # -- intake (the batcher's coalesce thread) ----------------------------
 
@@ -328,6 +400,7 @@ class LanePipeline:
 
         w.pool_gen, buffers = self.pool.acquire(key, alloc)
         w.pool_key = key
+        self._publish_staging_bytes(engine)
         # attach the buffers to the window BEFORE the fill: if a
         # misbehaving featurize hook makes host_stage raise (e.g. a
         # leaf with a mismatched leading dim), _fail_window must
@@ -378,6 +451,7 @@ class LanePipeline:
             self.pool.release(w.pool_key, w.pool_gen, w.host_tree)
             w.pool_key = None
             w.host_tree = None
+            self._publish_staging_bytes(engine)
 
     # stage 5: slice valid rows, resolve futures, close the loop on
     # request latency + window-rate series (the single-host-gather

@@ -248,11 +248,12 @@ class AutoCacheRule(Rule):
     def _budget(self) -> float:
         if self.mem_budget_bytes is not None:
             return float(self.mem_budget_bytes)
-        stats = None
-        try:
-            stats = jax.devices()[0].memory_stats()
-        except Exception:
-            pass
+        # the shared None-guarded memory_stats probe
+        # (observability/device.py — one code path with weighted_ls
+        # and the device memory gauges)
+        from keystone_tpu.observability.device import device_memory_stats
+
+        stats = device_memory_stats()
         if stats and "bytes_limit" in stats:
             free = stats["bytes_limit"] - stats.get("bytes_in_use", 0)
             return DEFAULT_BUDGET_FRACTION * free

@@ -122,6 +122,43 @@ def test_rebucket_acts_on_observed_traffic(fitted):
         assert gw.rebucket() is False
 
 
+def test_rebucket_audit_logs_observed_vs_predicted(fitted):
+    """Every swap records a goodput audit: the observed padding
+    efficiency under the outgoing buckets (live per-bucket counters)
+    next to the model-predicted efficiency of the proposal — the
+    auditable trail for ``suggest_buckets`` decisions."""
+    from keystone_tpu.serving.autoscale import predicted_efficiency
+
+    with make_gateway(
+        fitted, buckets=(8,), rebucket_k=2, max_delay_ms=0.5
+    ) as gw:
+        assert gw.last_rebucket_audit is None
+        for i in range(MIN_REBUCKET_OBSERVATIONS):
+            gw.predict(batch(1, seed=i)[0]).result(timeout=30)
+        observed_before = gw.observed_goodput()
+        assert observed_before["goodput_rows"] >= MIN_REBUCKET_OBSERVATIONS
+        # singleton rows through an 8-bucket: efficiency is poor
+        assert observed_before["efficiency"] < 0.5
+        hist = gw.observed_sizes()
+        assert gw.rebucket() is True
+        audit = gw.last_rebucket_audit
+        assert audit["from_buckets"] == [8]
+        assert audit["to_buckets"] == list(gw.buckets)
+        assert audit["observed_efficiency_before"] == pytest.approx(
+            observed_before["efficiency"], rel=0.2
+        )
+        # the prediction in the audit is the autoscale model's number
+        # for the histogram that drove the proposal
+        assert audit["predicted_efficiency_after"] == pytest.approx(
+            predicted_efficiency(hist, gw.buckets), rel=0.2
+        )
+        # the re-bucket it proposed is an actual improvement
+        assert (
+            audit["predicted_efficiency_after"]
+            > audit["observed_efficiency_before"]
+        )
+
+
 def test_maintenance_loop_rebuckets_in_background(fitted):
     with make_gateway(
         fitted, buckets=(8,), rebucket_k=2, max_delay_ms=0.5,

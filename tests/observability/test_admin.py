@@ -234,6 +234,14 @@ def test_varz_build_info_block():
     assert "# TYPE keystone_build_info gauge" in metrics
     assert 'keystone_build_info{git_sha="' in metrics
     assert "keystone_process_start_time_seconds" in metrics
+    # the detected device table rides the build block (cached one-time
+    # like the rest) and the scrape carries the device info gauge +
+    # the memory sampler's family (host-RAM fallback on CPU backends)
+    assert build["devices"], build
+    assert build["devices"][0]["platform"] == "cpu"
+    assert "peak_flops" in build["devices"][0]
+    assert 'keystone_device_info{kind="' in metrics
+    assert "keystone_device_memory_bytes{" in metrics
 
 
 def test_slz_endpoint_renders_monitors():

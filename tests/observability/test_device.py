@@ -1,0 +1,298 @@
+"""Device truth (observability/device.py): peak detection, the one
+None-guarded memory_stats probe, cost-model normalization, the cached
+device table, and the memory sampler's publish/absent/host-fallback
+behaviour."""
+
+from keystone_tpu.observability import device as device_mod
+from keystone_tpu.observability.prometheus import render
+from keystone_tpu.observability.registry import MetricsRegistry
+
+
+class FakeDevice:
+    def __init__(self, kind="TPU v4", platform="tpu", stats=None,
+                 raise_on_stats=False):
+        self.device_kind = kind
+        self.platform = platform
+        self._stats = stats
+        self._raise = raise_on_stats
+
+    def memory_stats(self):
+        if self._raise:
+            raise RuntimeError("no stats on this backend")
+        return self._stats
+
+
+# -- peak detection --------------------------------------------------------
+
+def test_peaks_for_known_kinds():
+    flops, membw = device_mod.peaks_for("TPU v4")
+    assert flops == 275e12 and membw == 1200e9
+    flops, _ = device_mod.peaks_for("TPU v5 lite")
+    assert flops == 197e12
+    flops, _ = device_mod.peaks_for("NVIDIA A100-SXM4-40GB")
+    assert flops == 312e12
+
+
+def test_peaks_for_unknown_is_none():
+    assert device_mod.peaks_for("cpu") == (None, None)
+    assert device_mod.peaks_for(None) == (None, None)
+    assert device_mod.peaks_for("quantum-annealer") == (None, None)
+
+
+def test_peaks_matching_is_word_bounded():
+    # "l4" must not claim an L40S — a false table hit would export a
+    # fabricated MFU denominator; unknown parts stay absent
+    assert device_mod.peaks_for("NVIDIA L40S") == (None, None)
+    assert device_mod.peaks_for("NVIDIA L4")[0] == 121e12
+    assert device_mod.peaks_for("NVIDIA T400") == (None, None)
+    # both spellings the runtime uses for Trillium resolve
+    assert device_mod.peaks_for("TPU v6e")[0] == 918e12
+    assert device_mod.peaks_for("TPU v6 lite")[0] == 918e12
+
+
+def test_peaks_env_override(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_PEAK_FLOPS", "5e12")
+    monkeypatch.setenv("KEYSTONE_PEAK_MEMBW_GBPS", "100")
+    assert device_mod.peaks_for("cpu") == (5e12, 100e9)
+    # override beats the table too
+    assert device_mod.peaks_for("TPU v4") == (5e12, 100e9)
+
+
+def test_peaks_env_partial_override_merges_with_table(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_PEAK_FLOPS", "5e12")
+    flops, membw = device_mod.peaks_for("TPU v4")
+    assert flops == 5e12 and membw == 1200e9
+
+
+def test_peaks_env_garbage_ignored(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_PEAK_FLOPS", "not-a-number")
+    assert device_mod.peaks_for("TPU v4")[0] == 275e12
+
+
+# -- the one memory_stats probe --------------------------------------------
+
+def test_device_memory_stats_none_guard():
+    assert device_mod.device_memory_stats(FakeDevice(stats=None)) is None
+    assert device_mod.device_memory_stats(FakeDevice(stats={})) is None
+    assert (
+        device_mod.device_memory_stats(FakeDevice(raise_on_stats=True))
+        is None
+    )
+    stats = {"bytes_in_use": 10, "bytes_limit": 100}
+    assert device_mod.device_memory_stats(FakeDevice(stats=stats)) == stats
+
+
+def test_device_memory_stats_default_device_cpu_is_none():
+    # the CPU backend reports no allocator stats: the shared probe
+    # (weighted_ls/auto_cache route through it) lands on None, never
+    # an exception
+    assert device_mod.device_memory_stats() is None
+
+
+def test_host_memory_stats_reports_limit():
+    stats = device_mod.host_memory_stats()
+    assert stats is not None
+    assert stats.get("bytes_limit", 0) > 0
+
+
+# -- cost-model normalization ----------------------------------------------
+
+class FakeCompiled:
+    def __init__(self, cost=None, mem=None, raise_cost=False):
+        self._cost = cost
+        self._mem = mem
+        self._raise = raise_cost
+
+    def cost_analysis(self):
+        if self._raise:
+            raise NotImplementedError("backend has no cost analysis")
+        return self._cost
+
+    def memory_analysis(self):
+        if self._mem is None:
+            raise NotImplementedError
+        return self._mem
+
+
+class FakeMem:
+    temp_size_in_bytes = 4096
+    argument_size_in_bytes = 256
+    output_size_in_bytes = 128
+
+
+def test_cost_model_from_plain_dict():
+    model = device_mod.compiled_cost_model(
+        FakeCompiled(cost={"flops": 100.0, "bytes accessed": 50.0})
+    )
+    assert model == {"flops": 100.0, "bytes_accessed": 50.0}
+
+
+def test_cost_model_from_list_wrapped_dict_and_memory():
+    model = device_mod.compiled_cost_model(
+        FakeCompiled(cost=[{"flops": 7.0}], mem=FakeMem())
+    )
+    assert model["flops"] == 7.0
+    assert model["temp_bytes"] == 4096
+    assert model["argument_bytes"] == 256
+
+
+def test_cost_model_absent_yields_empty_never_raises():
+    assert device_mod.compiled_cost_model(FakeCompiled(cost=None)) == {}
+    assert device_mod.compiled_cost_model(FakeCompiled(cost=[])) == {}
+    assert (
+        device_mod.compiled_cost_model(FakeCompiled(raise_cost=True)) == {}
+    )
+    assert (
+        device_mod.compiled_cost_model(
+            FakeCompiled(cost={"flops": "garbage", "bytes accessed": -1})
+        )
+        == {}
+    )
+
+
+# -- the cached device table -----------------------------------------------
+
+def test_device_table_detects_and_caches():
+    device_mod.reset_device_table()
+    try:
+        table = device_mod.device_table()
+        assert table, "CPU backend should still yield one row"
+        row = table[0]
+        assert row["platform"] == "cpu"
+        assert row["count"] >= 1
+        # cached: a second call returns an equal COPY (mutating the
+        # returned rows must not corrupt the cache)
+        again = device_mod.device_table()
+        assert again == table
+        again[0]["kind"] = "mutated"
+        assert device_mod.device_table()[0]["kind"] != "mutated"
+    finally:
+        device_mod.reset_device_table()
+
+
+def test_register_device_metrics_info_gauge():
+    device_mod.reset_device_table()
+    try:
+        reg = MetricsRegistry()
+        device_mod.register_device_metrics(reg)
+        text = render(reg.collect())
+        assert "# TYPE keystone_device_info gauge" in text
+        assert 'keystone_device_info{kind="' in text
+        assert 'platform="cpu"' in text
+    finally:
+        device_mod.reset_device_table()
+
+
+# -- the memory sampler ----------------------------------------------------
+
+def test_sampler_publishes_per_device_gauges():
+    reg = MetricsRegistry()
+    sampler = device_mod.DeviceMemorySampler(
+        registry=reg,
+        devices=[
+            FakeDevice(
+                kind="TPU v4",
+                stats={
+                    "bytes_in_use": 11,
+                    "peak_bytes_in_use": 22,
+                    "bytes_limit": 33,
+                },
+            ),
+            FakeDevice(kind="TPU v4", stats=None),  # no stats: absent
+        ],
+    )
+    assert sampler.sample_once() == 1
+    text = render(reg.collect())
+    assert (
+        'keystone_device_memory_bytes{device="0",kind="TPU v4",'
+        'stat="in_use"} 11' in text
+    )
+    assert (
+        'keystone_device_memory_bytes{device="0",kind="TPU v4",'
+        'stat="peak"} 22' in text
+    )
+    assert (
+        'keystone_device_memory_bytes{device="0",kind="TPU v4",'
+        'stat="limit"} 33' in text
+    )
+    # the stats-less accelerator contributed NO series (absent != zero)
+    assert 'device="1"' not in text
+    # non-cpu devices present: no host-RAM fallback row either
+    assert 'memory_bytes{device="host"' not in text
+
+
+def test_sampler_cpu_without_stats_falls_back_to_host_ram():
+    reg = MetricsRegistry()
+    sampler = device_mod.DeviceMemorySampler(
+        registry=reg,
+        devices=[FakeDevice(kind="cpu", platform="cpu", stats=None)],
+    )
+    assert sampler.sample_once() == 0
+    text = render(reg.collect())
+    assert (
+        'keystone_device_memory_bytes{device="host",kind="host-ram",'
+        'stat="limit"}' in text
+    )
+
+
+def test_sampler_empty_device_list_stays_absent():
+    # backend-init failure (no devices at all) must NOT scrape like a
+    # healthy CPU host: no host-RAM fallback, family absent
+    reg = MetricsRegistry()
+    sampler = device_mod.DeviceMemorySampler(registry=reg, devices=[])
+    assert sampler.sample_once() == 0
+    assert "keystone_device_memory_bytes{" not in render(reg.collect())
+
+
+def test_acquire_memory_sampler_tightest_interval_wins():
+    # a second holder asking for a tighter cadence must not be
+    # silently handed the first holder's slower one
+    reg = MetricsRegistry()
+    a = device_mod.acquire_memory_sampler(registry=reg, interval_s=60.0)
+    b = device_mod.acquire_memory_sampler(registry=reg, interval_s=1.0)
+    c = device_mod.acquire_memory_sampler(registry=reg, interval_s=30.0)
+    try:
+        assert a is b is c
+        assert a.interval_s == 1.0  # tightened, never loosened
+    finally:
+        for s in (a, b, c):
+            device_mod.release_memory_sampler(s)
+
+
+def test_acquire_release_memory_sampler_refcounts():
+    # admin + gateway in one process share ONE thread per registry
+    reg = MetricsRegistry()
+    a = device_mod.acquire_memory_sampler(registry=reg, interval_s=60.0)
+    b = device_mod.acquire_memory_sampler(registry=reg)
+    try:
+        assert a is b
+        assert a._thread is not None and a._thread.is_alive()
+        device_mod.release_memory_sampler(a)
+        assert a._thread.is_alive()  # still held by b
+    finally:
+        device_mod.release_memory_sampler(b)
+    assert a._thread is None  # last release stopped the thread
+    # a directly-constructed sampler releases to a plain stop()
+    solo = device_mod.DeviceMemorySampler(registry=reg, devices=[])
+    solo.start()
+    device_mod.release_memory_sampler(solo)
+    assert solo._thread is None
+
+
+def test_sampler_start_stop_thread():
+    reg = MetricsRegistry()
+    sampler = device_mod.DeviceMemorySampler(
+        registry=reg, interval_s=0.05,
+        devices=[FakeDevice(stats={"bytes_in_use": 5})],
+    )
+    sampler.start()
+    try:
+        assert sampler._thread.is_alive()
+        gauge = reg.gauge(
+            "keystone_device_memory_bytes", "",
+            ("device", "kind", "stat"),
+        )
+        assert gauge.get(("0", "TPU v4", "in_use")) == 5.0
+    finally:
+        sampler.stop()
+    assert sampler._thread is None

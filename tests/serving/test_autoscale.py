@@ -3,8 +3,36 @@
 import numpy as np
 import pytest
 
-from keystone_tpu.serving.autoscale import padding_waste, suggest_buckets
+from keystone_tpu.serving.autoscale import (
+    padding_waste,
+    predicted_efficiency,
+    suggest_buckets,
+)
 from keystone_tpu.serving.metrics import ServingMetrics
+
+
+def test_predicted_efficiency_matches_waste_model():
+    hist = {3: 2, 7: 1}  # through buckets (4, 8): waste 2*1 + 1*1 = 3
+    assert padding_waste(hist, (4, 8)) == 3
+    # 13 valid rows, 16 shipped
+    assert predicted_efficiency(hist, (4, 8)) == pytest.approx(13 / 16)
+    # exact-fit traffic: no waste, efficiency 1
+    assert predicted_efficiency({4: 5, 8: 2}, (4, 8)) == 1.0
+    # empty histogram: no prediction, not a fake number
+    assert predicted_efficiency({}, (4, 8)) is None
+
+
+def test_predicted_efficiency_agrees_with_live_counters():
+    """The offline model and the live per-dispatch goodput counters
+    must tell the same story for the same traffic (the supersede
+    contract: the counters are ground truth, the model predicts
+    them)."""
+    m = ServingMetrics()
+    for size, bucket in ((3, 4), (7, 8), (4, 4), (8, 8)):
+        m.record_dispatch(bucket=bucket, n_valid=size)
+    live = m.padding_efficiency()
+    modeled = predicted_efficiency(m, (4, 8))
+    assert live == pytest.approx(modeled)
 
 
 def test_clustered_traffic_mix_finds_the_clusters():

@@ -141,6 +141,74 @@ def test_metrics_summary_shape(fitted):
     assert s["dispatch_p50_ms"] is not None
 
 
+def test_warmup_registers_cost_models(fitted):
+    """Warmup pulls each bucket program's static XLA cost model via the
+    AOT lower/compile path (which shares the jit caches — the
+    compile-count contract holds) — on this container's CPU backend
+    cost_analysis IS available, so flops/bytes land per bucket and
+    scale with the bucket size."""
+    engine = CompiledPipeline(fitted, buckets=(4, 8))
+    engine.warmup(example=jnp.zeros((D,), jnp.float32))
+    assert engine.metrics.compile_count == 2  # AOT added no traces
+    models = engine.metrics.cost_models
+    assert set(models) == {4, 8}
+    assert models[4]["flops"] > 0
+    # twice the rows through the same program ~ twice the modeled work
+    assert models[8]["flops"] == pytest.approx(
+        2 * models[4]["flops"], rel=0.2
+    )
+    # dispatches then attribute modeled FLOPs to traffic
+    engine.apply(batch(3), sync=True)
+    assert engine.metrics.device_flops.total == models[4]["flops"]
+
+
+def test_cost_analysis_unavailable_degrades_to_absent(fitted, monkeypatch):
+    """The graceful-degradation contract: a backend returning no cost
+    analysis (None/empty) yields ABSENT cost/MFU/roofline series — not
+    zeros, not a crash — and serving works identically."""
+    from keystone_tpu.observability import device as device_mod
+    from keystone_tpu.observability.prometheus import render
+    from keystone_tpu.observability.registry import MetricsRegistry
+
+    monkeypatch.setattr(
+        device_mod, "compiled_cost_model", lambda compiled: {}
+    )
+    reg = MetricsRegistry()
+    engine = CompiledPipeline(fitted, buckets=(4,))
+    engine.metrics.register(registry=reg, engine="no-cost")
+    engine.warmup(example=jnp.zeros((D,), jnp.float32))
+    assert engine.metrics.cost_models == {}
+    assert engine.metrics.mfu() is None
+    assert engine.metrics.roofline_bound(4) is None
+    got = np.asarray(engine.apply(batch(3), sync=True))
+    assert got.shape == (3, 3)
+    text = render(reg.collect())
+    assert "keystone_device_flops_per_dispatch" not in text
+    assert "keystone_serving_mfu" not in text
+    assert "keystone_device_roofline_bound" not in text
+    # goodput accounting is dispatch-side, not cost-model-side: present
+    assert (
+        'keystone_serving_goodput_rows_total{engine="no-cost",'
+        'bucket="4"} 3' in text
+    )
+
+
+def test_cost_model_lowering_failure_is_nonfatal(fitted):
+    """An AOT lower/compile that raises (backends without AOT support)
+    is swallowed inside ``_register_cost_model``: warmup and serving
+    keep working, the model stays absent."""
+    engine = CompiledPipeline(fitted, buckets=(4,))
+
+    class BoomFn:
+        def lower(self, *a, **k):
+            raise NotImplementedError("no AOT on this backend")
+
+    engine._register_cost_model(4, BoomFn(), None)
+    assert engine.metrics.cost_models == {}
+    engine.warmup(example=jnp.zeros((D,), jnp.float32))
+    assert np.asarray(engine.apply(batch(2), sync=True)).shape == (2, 3)
+
+
 @pytest.mark.needs_mesh8
 def test_sharded_engine_matches_unsharded(fitted, mesh8):
     """Multi-chip serving: buckets round up to the shard count, the

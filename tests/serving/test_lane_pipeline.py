@@ -416,6 +416,70 @@ def test_host_prep_failure_does_not_poison_pool(fitted):
         assert np.asarray(good.result(timeout=60)).shape == (3,)
 
 
+def test_goodput_counters_bitwise_against_window_shapes(fitted):
+    """Device-truth goodput accounting through the lane pipeline's
+    compute stage: known window shapes -> EXACT per-bucket valid/padded
+    row counts (the same ``record_dispatch`` path the serial engine
+    uses — one code path, same numbers)."""
+    engine = CompiledPipeline(fitted, buckets=(4, 8))
+    engine.warmup(example=jnp.zeros((D,), jnp.float32))
+    # bursts of 3, 4, 7: windows of exactly those sizes (generous
+    # coalesce deadline), dispatching buckets 4, 4, 8
+    with MicroBatcher(
+        engine, max_delay_ms=150.0, pipeline_depth=2
+    ) as mb:
+        _run_bursts(
+            mb,
+            [
+                [batch(1, seed=70 + i)[0] for _ in range(n)]
+                for i, n in enumerate((3, 4, 7))
+            ],
+        )
+    m = engine.metrics
+    assert m.examples.snapshot() == {4: 7, 8: 7}
+    assert m.padded_rows.snapshot() == {4: 1, 8: 1}
+    assert m.examples.total == 14
+    assert m.padded_rows.total == 2
+    # efficiency gauge agrees bitwise with the counters: 14 / 16
+    assert m.padding_efficiency() == pytest.approx(14 / 16)
+
+
+def test_staging_bytes_gauge_tracks_pool(fitted):
+    """The HostBufferPool's live byte accounting reaches the engine's
+    staging-bytes gauge, and pooled + outstanding bytes return to the
+    pooled side once windows complete."""
+    engine = CompiledPipeline(fitted, buckets=(8,))
+    engine.warmup(example=jnp.zeros((D,), jnp.float32))
+    with MicroBatcher(
+        engine, max_delay_ms=50.0, max_batch=8, pipeline_depth=2
+    ) as mb:
+        pool = mb._pipeline.pool
+        for f in [mb.submit(x) for x in batch(8, seed=80)]:
+            f.result(timeout=60)
+        # one 8-row float32 staging buffer of width D
+        expect = 8 * D * 4
+        assert pool.staging_bytes == expect * (
+            pool.allocations
+        )
+        assert engine.metrics.staging_bytes == pool.staging_bytes
+        # a swap resets the accounting with the pool AND the gauge —
+        # a /metrics scrape right after the swap must not export the
+        # pre-swap footprint
+        mb.swap_engine(engine)
+        assert pool.staging_bytes == 0
+        assert engine.metrics.staging_bytes == 0
+        # swap to an engine with its OWN metrics: post-swap windows
+        # gauge the current engine's series only — the retired one
+        # stays zeroed (no cross-engine double count)
+        engine2 = CompiledPipeline(fitted, buckets=(8,), name="swap-tgt")
+        engine2.warmup(example=jnp.zeros((D,), jnp.float32))
+        mb.swap_engine(engine2)
+        for f in [mb.submit(x) for x in batch(8, seed=81)]:
+            f.result(timeout=60)
+        assert engine.metrics.staging_bytes == 0
+        assert engine2.metrics.staging_bytes == pool.staging_bytes > 0
+
+
 class TestHostBufferPool:
     def test_acquire_reuse_and_cap(self):
         pool = HostBufferPool(max_per_key=2)

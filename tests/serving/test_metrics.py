@@ -91,6 +91,10 @@ def test_windowed_rate_clamps_oversized_window():
     30s sum by more seconds (4x undercount otherwise)."""
     m = ServingMetrics()
     m.record_dispatch(bucket=8, n_valid=8, seconds=0.001)
+    # let some lifetime accrue so the microseconds between the two
+    # reads below are noise, not a 2x swing in the divisor (this test
+    # used to flake under full-suite load on a young instance)
+    time.sleep(0.05)
     lifetime = m.examples_per_sec()  # window = lifetime here (young)
     assert m.examples_per_sec(window=1e6) == pytest.approx(
         lifetime, rel=0.5
@@ -122,6 +126,148 @@ def test_same_label_reregistration_transfers_ownership():
     assert samples[0].value == 6  # the NEW engine's counter
     # the superseded collector pruned itself; old engine still alive
     assert old.examples.total == 1
+
+
+def _render(reg):
+    from keystone_tpu.observability.prometheus import render
+
+    return render(reg.collect())
+
+
+def test_goodput_families_golden_strings():
+    """Per-bucket goodput accounting on the scrape surface: valid vs
+    padded rows per bucket and the windowed padding-efficiency gauge."""
+    reg = MetricsRegistry()
+    m = ServingMetrics()
+    m.register(registry=reg, engine="gp")
+    m.record_dispatch(bucket=8, n_valid=5)
+    m.record_dispatch(bucket=8, n_valid=8)
+    m.record_dispatch(bucket=4, n_valid=1)
+    text = _render(reg)
+    for want in (
+        '# TYPE keystone_serving_goodput_rows_total counter',
+        'keystone_serving_goodput_rows_total{engine="gp",bucket="4"} 1',
+        'keystone_serving_goodput_rows_total{engine="gp",bucket="8"} 13',
+        'keystone_serving_padded_rows_total{engine="gp",bucket="4"} 3',
+        'keystone_serving_padded_rows_total{engine="gp",bucket="8"} 3',
+        '# TYPE keystone_serving_padding_efficiency gauge',
+    ):
+        assert want in text, f"missing {want!r} in:\n{text}"
+    # 14 valid rows of 20 shipped
+    assert m.padding_efficiency() == pytest.approx(14 / 20)
+    assert (
+        f'keystone_serving_padding_efficiency{{engine="gp"}} {14 / 20!r}'
+        in text
+    )
+
+
+def test_device_cost_families_golden_strings():
+    """Cost model + peaks -> flops-per-dispatch, temp-HBM, modeled
+    FLOPs counter, rolling MFU, and the roofline one-hot."""
+    reg = MetricsRegistry()
+    m = ServingMetrics()
+    m.register(registry=reg, engine="dev")
+    m.set_cost_model(8, {
+        "flops": 1000.0, "bytes_accessed": 10.0, "temp_bytes": 64.0,
+    })
+    m.set_cost_model(4, {
+        "flops": 10.0, "bytes_accessed": 1000.0,
+    })
+    # ridge point = 1e6 / 1e4 = 100 flops/byte: bucket 8 (100 f/B) is
+    # compute-bound, bucket 4 (0.01 f/B) bandwidth-bound
+    m.set_device_peaks(1e6, 1e4, n_devices=1)
+    m.record_dispatch(bucket=8, n_valid=6)
+    text = _render(reg)
+    for want in (
+        'keystone_device_flops_per_dispatch{engine="dev",bucket="4"} 10',
+        'keystone_device_flops_per_dispatch{engine="dev",bucket="8"} 1000',
+        'keystone_device_bytes_per_dispatch{engine="dev",bucket="8"} 10',
+        'keystone_device_temp_hbm_bytes{engine="dev",bucket="8"} 64',
+        'keystone_serving_device_flops_total{engine="dev"} 1000',
+        'keystone_device_roofline_bound{engine="dev",bucket="8",'
+        'bound="compute"} 1',
+        'keystone_device_roofline_bound{engine="dev",bucket="8",'
+        'bound="bandwidth"} 0',
+        'keystone_device_roofline_bound{engine="dev",bucket="4",'
+        'bound="bandwidth"} 1',
+        '# TYPE keystone_serving_mfu gauge',
+        'keystone_serving_mfu{engine="dev"} ',
+    ):
+        assert want in text, f"missing {want!r} in:\n{text}"
+    # bucket 4 has no temp_bytes: that cell is absent, not zero
+    assert (
+        'keystone_device_temp_hbm_bytes{engine="dev",bucket="4"}'
+        not in text
+    )
+    assert m.mfu() is not None and m.mfu() > 0
+    assert m.roofline_bound(8) == "compute"
+    assert m.roofline_bound(4) == "bandwidth"
+
+
+def test_device_families_absent_without_cost_model_or_peaks():
+    """No cost analysis and unknown hardware -> NO device-truth series
+    (absent, never zeros), while the classic families still export."""
+    reg = MetricsRegistry()
+    m = ServingMetrics()
+    m.register(registry=reg, engine="bare")
+    m.record_dispatch(bucket=8, n_valid=5)
+    text = _render(reg)
+    for absent in (
+        "keystone_device_flops_per_dispatch",
+        "keystone_device_bytes_per_dispatch",
+        "keystone_device_temp_hbm_bytes",
+        "keystone_device_roofline_bound",
+        "keystone_serving_device_flops_total",
+        "keystone_serving_mfu",
+        "keystone_serving_staging_bytes",
+    ):
+        assert absent not in text, f"{absent} must be absent:\n{text}"
+    assert 'keystone_serving_examples_total{engine="bare"} 5' in text
+    # peaks without a cost model still yield no MFU (nothing to count)
+    m.set_device_peaks(1e12, 1e11)
+    assert m.mfu() is None
+    # a cost model with peaks but no bytes_accessed: no roofline
+    m.set_cost_model(8, {"flops": 5.0})
+    assert m.roofline_bound(8) is None
+
+
+def test_empty_cost_model_is_dropped():
+    m = ServingMetrics()
+    m.set_cost_model(8, {})
+    assert m.cost_models == {}
+
+
+def test_padding_efficiency_none_before_traffic_and_windowed():
+    m = ServingMetrics()
+    assert m.padding_efficiency() is None
+    m.record_dispatch(bucket=8, n_valid=8)
+    assert m.padding_efficiency() == pytest.approx(1.0)
+    time.sleep(0.05)
+    # outside the window: gauge decays to absent, not a stale 1.0
+    assert m.padding_efficiency(window=0.01) is None
+
+
+def test_mfu_scales_with_device_count():
+    m = ServingMetrics()
+    m.set_cost_model(8, {"flops": 100.0})
+    m.record_dispatch(bucket=8, n_valid=8)
+    # pin the windowed rate: MFU = flops/s over peak * n_devices
+    m.flops_per_sec = lambda window=None: 500.0
+    m.set_device_peaks(1e3, None, n_devices=1)
+    assert m.mfu() == pytest.approx(0.5)
+    m.set_device_peaks(1e3, None, n_devices=4)
+    assert m.mfu() == pytest.approx(0.125)
+
+
+def test_staging_bytes_gauge_exports_when_set():
+    reg = MetricsRegistry()
+    m = ServingMetrics()
+    m.register(registry=reg, engine="stg")
+    m.set_staging_bytes(4096)
+    assert (
+        'keystone_serving_staging_bytes{engine="stg"} 4096'
+        in _render(reg)
+    )
 
 
 def test_engine_autoregisters_into_global_registry():
