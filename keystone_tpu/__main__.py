@@ -114,6 +114,12 @@ def main(argv=None) -> int:
               "keystone_tpu/serving/bench.py)")
         print("  serve-gateway  (HTTP request plane over the bench "
               "pipeline; keystone_tpu/gateway/)")
+        print("  serve-loadgen  (trace-driven open-loop load generator "
+              "+ chaos harness against a live gateway; replays "
+              "--request-log recordings or synthesizes Poisson/heavy-"
+              "tail arrivals, arms fault points mid-run via /chaosz, "
+              "and exits nonzero unless the serving invariants held; "
+              "keystone_tpu/loadgen/)")
         print("options:")
         print("  --gateway-port N shorthand for `serve-gateway "
               "--gateway-port N`: admission-")
@@ -160,6 +166,10 @@ def main(argv=None) -> int:
         if gateway_port is not None:
             rest = ["--gateway-port", str(gateway_port)] + rest
         return serve_gateway_main(rest)
+    if app == "serve-loadgen":
+        from keystone_tpu.loadgen.cli import main as serve_loadgen_main
+
+        return serve_loadgen_main(argv[1:])
     if app not in APPS:
         print(f"unknown app {app!r}; run with --help for the list")
         return 2

@@ -41,16 +41,31 @@ A stdlib ``http.server`` on a background daemon thread, following the
   The smoke script's forced-swap drill.
 - ``POST /drain`` — begin graceful shutdown in the background;
   ``/readyz`` flips 503 immediately, admitted requests resolve.
+- ``GET /chaosz`` / ``POST /chaosz`` — the fault-injection plane's
+  admin surface (``loadgen/faults.py``): GET lists the fault-point
+  catalog, armed specs, and fire counts; POST ``{"arm": {"point":
+  ..., "count": ..., "delay_ms": ..., "for_s": ..., "match": {...}}}``
+  arms a point in THIS process (400 for a point outside the catalog),
+  ``{"disarm": "<point>"}`` / ``{"disarm": "*"}`` clears. This is how
+  the load generator injects faults into a live gateway from outside.
 
 With ``--request-log`` (or ``GatewayServer(request_log=True)``) every
-``/predict`` instance also emits one structured JSON line to stdout —
-``{"ts", "status", "latency_ms", "lane", "trace_id"}`` — so a
-flight-recorder trace id found at ``/debugz`` is greppable straight
-from the process log.
+``/predict`` instance also emits one structured JSON line — ``{"ts",
+"status", "latency_ms", "lane", "trace_id", "n_rows", "shape",
+"deadline_ms"}`` — so a flight-recorder trace id found at ``/debugz``
+is greppable straight from the process log, and the line carries
+enough to RECONSTRUCT the request: ``loadgen/trace.py`` parses these
+records back into a replayable trace (``n_rows`` = instances in the
+originating POST; old-format lines without the replay fields still
+parse as single-instance events). Lines go to stdout by default;
+``--request-log FILE`` (or ``GatewayServer(request_log="path")``)
+appends them line-buffered to a JSONL file instead, so record/replay
+needs no process-output scraping.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import threading
@@ -62,6 +77,7 @@ import numpy as np
 
 from keystone_tpu.gateway.admission import Overloaded
 from keystone_tpu.gateway.lifecycle import Gateway
+from keystone_tpu.loadgen import faults
 from keystone_tpu.observability import device as device_obs
 from keystone_tpu.observability import flight as flight_mod
 from keystone_tpu.observability import profilez as profilez_mod
@@ -75,6 +91,20 @@ logger = logging.getLogger(__name__)
 # generous server-side ceiling for waiting on one prediction; requests
 # with their own deadline wait deadline + slack instead
 RESULT_TIMEOUT_S = 60.0
+
+# per-POST identity for the request log: concurrent handler threads
+# interleave their per-instance lines, so a replayer can't rely on
+# adjacency — lines from one POST share a post_seq instead
+# (next() on itertools.count is atomic under the GIL). The random
+# per-process prefix keeps ids unique across restarts: request logs
+# open in APPEND mode, and a counter restarting at 1 would make a
+# second session's posts dedupe away against the first's.
+_POST_NONCE = "%08x" % __import__("random").getrandbits(32)
+_POST_SEQ = itertools.count(1)
+
+
+def _next_post_seq() -> str:
+    return f"{_POST_NONCE}-{next(_POST_SEQ)}"
 
 
 def _status_for(err: Overloaded) -> int:
@@ -139,6 +169,16 @@ class _Handler(JsonHandler):
                     q.get("seconds", [None])[0]
                 )
                 self._send_json(doc, code=code, indent=1)
+            elif path == "/chaosz":
+                if not self.server.chaos_routes:  # type: ignore[attr-defined]
+                    self._send_error_json(
+                        404, "chaos_routes_disabled",
+                        detail="started with --no-chaosz",
+                    )
+                else:
+                    self._send_json(
+                        faults.get_injector().status(), indent=1
+                    )
             elif path == "/tracez":
                 from keystone_tpu.observability.tracing import (
                     get_tracer,
@@ -158,7 +198,7 @@ class _Handler(JsonHandler):
                 self._send_text(
                     404,
                     "not found; try /predict /readyz /healthz /metrics "
-                    "/slz /debugz /tracez /profilez\n",
+                    "/slz /debugz /tracez /profilez /chaosz\n",
                 )
         except Exception as e:
             logger.exception("gateway GET error for %s", self.path)
@@ -171,28 +211,57 @@ class _Handler(JsonHandler):
         lane: Optional[int] = None,
         trace_id: Optional[str] = None,
         error: Optional[str] = None,
+        n_rows: Optional[int] = None,
+        shape: Optional[tuple] = None,
+        deadline_ms: Optional[float] = None,
     ) -> None:
-        """One structured JSON line per /predict instance on stdout
+        """One structured JSON line per /predict instance
         (``--request-log``): trace ids surfaced at /debugz are
-        greppable straight from the process log."""
+        greppable straight from the process log, and the
+        ``n_rows``/``shape``/``deadline_ms`` fields make the record
+        REPLAYABLE (``loadgen/trace.py`` reconstructs the request
+        from them; pre-loadgen readers can ignore the extra keys)."""
+        meta = getattr(self, "_log_meta", None) or {}
         line = {
-            "ts": round(time.time(), 6),
+            # arrival time (see do_POST), so replay preserves the
+            # recorded arrival pattern rather than completion order
+            "ts": round(getattr(self, "_t_wall", None) or time.time(), 6),
             "path": "/predict",
             "status": status,
             "latency_ms": round(latency_s * 1e3, 3),
             "lane": lane,
             "trace_id": trace_id,
+            "n_rows": n_rows if n_rows is not None else meta.get("n_rows"),
+            "shape": (
+                list(shape) if shape is not None else meta.get("shape")
+            ),
+            "deadline_ms": (
+                deadline_ms if deadline_ms is not None
+                else meta.get("deadline_ms")
+            ),
+            "post_seq": meta.get("post_seq"),
         }
         if error is not None:
             line["error"] = error
-        print(json.dumps(line), flush=True)
+        self.server.write_request_log(line)  # type: ignore[attr-defined]
 
     def do_POST(self) -> None:  # noqa: N802 (stdlib handler API)
         path = urlparse(self.path).path
         self._t_post = time.perf_counter()
+        # ARRIVAL wall time: request-log lines stamp this (not
+        # log-emit time, which for success lines is after the whole
+        # POST resolved) — the replayer treats ts as the arrival
+        # clock, so completion-time stamps would distort the recorded
+        # inter-arrival gaps by per-request latency
+        self._t_wall = time.time()
+        # request-log context for the error handlers below; _predict
+        # fills it once the body parses
+        self._log_meta = {}
         try:
             if path == "/predict":
                 self._predict()
+            elif path == "/chaosz":
+                self._chaosz()
             elif path == "/swap":
                 swapped = self.gateway.rebucket(force=True)
                 self._send_json(
@@ -209,7 +278,9 @@ class _Handler(JsonHandler):
                 ).start()
                 self._send_json({"draining": True})
             else:
-                self._send_text(404, "not found; try /predict /swap /drain\n")
+                self._send_text(
+                    404, "not found; try /predict /swap /drain /chaosz\n"
+                )
         except Overloaded as e:
             code = _status_for(e)
             if path == "/predict" and self.server.request_log:  # type: ignore[attr-defined]
@@ -233,6 +304,57 @@ class _Handler(JsonHandler):
     def _read_body(self) -> bytes:
         length = int(self.headers.get("Content-Length", 0) or 0)
         return self.rfile.read(length) if length else b""
+
+    def _chaosz(self) -> None:
+        """Arm/disarm fault points in this process (the load
+        generator's remote chaos control; see loadgen/faults.py)."""
+        if not self.server.chaos_routes:  # type: ignore[attr-defined]
+            self._send_error_json(
+                404, "chaos_routes_disabled",
+                detail="started with --no-chaosz",
+            )
+            return
+        injector = faults.get_injector()
+        try:
+            doc = json.loads(self._read_body() or b"{}")
+        except ValueError as e:
+            self._send_error_json(400, "bad_request", detail=str(e))
+            return
+        if "arm" in doc:
+            spec = doc["arm"]
+            if not isinstance(spec, dict) or "point" not in spec:
+                self._send_error_json(
+                    400, "bad_request",
+                    detail='arm wants {"point": ..., [count/delay_ms/'
+                           'for_s/match]}',
+                )
+                return
+            spec = dict(spec)
+            point = spec.pop("point")
+            if point not in faults.FAULT_POINTS:
+                self._send_error_json(
+                    400, "unknown_fault_point", point=point,
+                    known=sorted(faults.FAULT_POINTS),
+                )
+                return
+            try:
+                injector.arm(point, **spec)
+            except (TypeError, ValueError) as e:
+                self._send_error_json(400, "bad_request", detail=str(e))
+                return
+        elif "disarm" in doc:
+            point = doc["disarm"]
+            if point == "*":
+                injector.disarm_all()
+            else:
+                injector.disarm(point)
+        else:
+            self._send_error_json(
+                400, "bad_request",
+                detail='want {"arm": {...}} or {"disarm": "<point>|*"}',
+            )
+            return
+        self._send_json(injector.status(), indent=1)
 
     def _predict(self) -> None:
         try:
@@ -261,6 +383,15 @@ class _Handler(JsonHandler):
         except (ValueError, TypeError) as e:
             self._send_error_json(400, "bad_request", detail=str(e))
             return
+        # replay context for every log line this POST emits (including
+        # the typed-shed/error lines in do_POST's handlers): what the
+        # request WAS, so loadgen can reissue it
+        self._log_meta = {
+            "n_rows": len(examples),
+            "shape": list(examples[0].shape),
+            "deadline_ms": deadline_ms,
+            "post_seq": _next_post_seq(),
+        }
         # admit every instance BEFORE waiting on any: concurrent
         # instances coalesce into shared micro-batch windows
         futures = []
@@ -302,7 +433,7 @@ class _Handler(JsonHandler):
             return
         if self.server.request_log:  # type: ignore[attr-defined]
             whole_post_s = time.perf_counter() - self._t_post
-            for f in futures:
+            for ex, f in zip(examples, futures):
                 # per-request latency as the admission layer measured
                 # it (rides the future) — iterating result() above
                 # would charge every instance the wait on instance 0
@@ -311,6 +442,9 @@ class _Handler(JsonHandler):
                     getattr(f, "latency_s", None) or whole_post_s,
                     lane=getattr(f, "lane_index", None),
                     trace_id=getattr(f, "trace_id", None),
+                    n_rows=len(examples),
+                    shape=ex.shape,
+                    deadline_ms=deadline_ms,
                 )
         self._send_json({"predictions": [p.tolist() for p in preds]})
 
@@ -330,8 +464,17 @@ class GatewayServer(BackgroundServer, device_obs.MemorySamplerHost):
         host: str = "127.0.0.1",
         registry=None,
         input_dtype: Any = np.float32,
-        request_log: bool = False,
+        request_log: Any = False,
+        chaos_routes: bool = True,
     ):
+        """``request_log``: falsy = off; True = one JSON line per
+        /predict instance on stdout; a path string = append the lines
+        to that JSONL file, line-buffered (the loadgen record/replay
+        path — no process-output scraping). ``chaos_routes=False``
+        removes the /chaosz fault-injection surface from this
+        frontend (a production deployment that is not a chaos
+        experiment shouldn't expose sabotage routes to anyone who
+        can reach /predict)."""
         super().__init__(port=port, host=host)
         self.gateway = gateway
         self.registry = (
@@ -339,6 +482,17 @@ class GatewayServer(BackgroundServer, device_obs.MemorySamplerHost):
         )
         self.input_dtype = np.dtype(input_dtype)
         self.request_log = bool(request_log)
+        self.chaos_routes = bool(chaos_routes)
+        self._request_log_file = None
+        self._request_log_lock = threading.Lock()
+        self._log_to_file = isinstance(request_log, (str, bytes)) or hasattr(
+            request_log, "__fspath__"
+        )
+        if self._log_to_file:
+            self._request_log_file = open(  # noqa: SIM115 (held open
+                # for the server's lifetime; stop() closes it)
+                request_log, "a", buffering=1, encoding="utf-8",
+            )
         # single-port deployments scrape THIS port: carry the device
         # identity gauge and the memory sampler here too, same as the
         # admin endpoint (refcounted — one thread per registry even
@@ -350,6 +504,23 @@ class GatewayServer(BackgroundServer, device_obs.MemorySamplerHost):
         httpd.registry = self.registry
         httpd.input_dtype = self.input_dtype
         httpd.request_log = self.request_log
+        httpd.chaos_routes = self.chaos_routes
+        httpd.write_request_log = self.write_request_log
+
+    def write_request_log(self, line: dict) -> None:
+        """One record to the request log (stdout or the file). Handler
+        threads are concurrent; the lock keeps lines whole."""
+        text = json.dumps(line)
+        if not self._log_to_file:
+            print(text, flush=True)
+            return
+        with self._request_log_lock:
+            # re-read under the lock: daemon handler threads are not
+            # joined by stop(), so a straggler can race the close —
+            # it must drop its line, not write to a closed file
+            out = self._request_log_file
+            if out is not None:
+                out.write(text + "\n")
 
     def start(self) -> "GatewayServer":
         super().start()
@@ -359,6 +530,10 @@ class GatewayServer(BackgroundServer, device_obs.MemorySamplerHost):
     def stop(self) -> None:
         self._stop_memory_sampler()
         super().stop()
+        if self._request_log_file is not None:
+            with self._request_log_lock:
+                self._request_log_file.close()
+                self._request_log_file = None
 
 
 def main(argv=None) -> int:
@@ -403,10 +578,19 @@ def main(argv=None) -> int:
                     "latency threshold")
     ap.add_argument("--flight-capacity", type=int, default=64,
                     help="forensic ring size (requests)")
-    ap.add_argument("--request-log", action="store_true",
+    ap.add_argument("--request-log", nargs="?", const=True,
+                    default=False, metavar="FILE",
                     help="one structured JSON line per /predict "
-                    "instance on stdout (status, latency_ms, lane, "
-                    "trace_id)")
+                    "instance (status, latency_ms, lane, trace_id, "
+                    "plus the n_rows/shape/deadline_ms replay fields "
+                    "loadgen consumes). Bare flag: stdout; with FILE: "
+                    "append line-buffered JSONL there (record/replay "
+                    "without scraping process output)")
+    ap.add_argument("--no-chaosz", action="store_true",
+                    help="disable the /chaosz fault-injection routes "
+                    "on this frontend (for serving deployments that "
+                    "are not chaos experiments; faults stay armable "
+                    "in-process via code/env)")
     ap.add_argument("--d", type=int, default=256)
     ap.add_argument("--hidden", type=int, default=512)
     ap.add_argument("--depth", type=int, default=4)
@@ -441,14 +625,22 @@ def main(argv=None) -> int:
         flight_capacity=args.flight_capacity,
     )
     gateway.install_signal_handlers()
+    # chaos experiments can pre-arm fault points from the environment
+    # (KEYSTONE_FAULTS="point=k:v,... ..."); absent env is a no-op.
+    # This must run AFTER the Gateway exists: trigger points
+    # (gateway.swap.force) disarm immediately when nothing has
+    # registered for them, so arming before construction would be a
+    # silent no-op.
+    faults.arm_from_env()
     server = GatewayServer(
         gateway, port=args.port, host=args.host,
         request_log=args.request_log,
+        chaos_routes=not args.no_chaosz,
     ).start()
     print(
         f"gateway: {server.url()} (POST /predict, GET /readyz, "
         "GET /metrics, GET /slz, GET /debugz, GET /profilez, "
-        "POST /swap, POST /drain)",
+        "POST /swap, POST /drain, GET|POST /chaosz)",
         flush=True,
     )
     try:

@@ -51,6 +51,7 @@ from typing import Any, Dict, Optional, Sequence
 from keystone_tpu.gateway.admission import AdmissionController, Overloaded
 from keystone_tpu.gateway.metrics import GatewayMetrics
 from keystone_tpu.gateway.pool import EnginePool
+from keystone_tpu.loadgen import faults
 from keystone_tpu.observability.flight import FlightRecorder
 from keystone_tpu.observability.slo import Slo, SloMonitor
 from keystone_tpu.serving.autoscale import (
@@ -235,6 +236,16 @@ class Gateway:
         # not interleave build/swap/assign sequences
         self._swap_lock = threading.RLock()
         self._maint_stop = threading.Event()
+        # chaos point: arming gateway.swap.force (via code, env, or
+        # POST /chaosz; match gateway=<name> to target one of several)
+        # forces a live rebucket on a background thread — the "swap
+        # under peak load" experiment, driving the same path as
+        # POST /swap
+        self._chaos_unregister = faults.get_injector().register_trigger(
+            "gateway.swap.force",
+            self._chaos_forced_swap,
+            ctx={"gateway": name},
+        )
         self._maint: Optional[threading.Thread] = None
         if maintenance_interval_s:
             self._maint = threading.Thread(
@@ -421,6 +432,24 @@ class Gateway:
             )
             self._buckets = buckets
 
+    def _chaos_forced_swap(self, spec) -> None:
+        """``gateway.swap.force`` trigger body (injector background
+        thread): one forced live swap, mid-whatever-load-is-running."""
+        if self._closed:
+            return
+        logger.warning(
+            "gateway %s: chaos-forced live swap (fault point armed)",
+            self.name,
+        )
+        try:
+            self.rebucket(force=True)
+        except Exception:
+            # chaos must surface as symptoms, not crash the trigger
+            # thread: the old engines keep serving on a failed swap
+            logger.exception(
+                "gateway %s: chaos-forced swap failed", self.name
+            )
+
     def _maintenance_loop(self, interval_s: float) -> None:
         while not self._maint_stop.wait(interval_s):
             try:
@@ -450,6 +479,8 @@ class Gateway:
         if not first:
             self._drained.wait(timeout)
             return
+        # a retired gateway must stop receiving chaos triggers
+        self._chaos_unregister()
         self._maint_stop.set()
         if self.slo_monitor is not None:
             self.slo_monitor.stop()

@@ -43,6 +43,7 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable, List, Optional, Sequence
 
+from keystone_tpu.loadgen import faults
 from keystone_tpu.serving.batching import MicroBatcher
 from keystone_tpu.serving.engine import CompiledPipeline
 
@@ -130,6 +131,17 @@ class Lane:
     ) -> Future:
         with self._lock:
             self._inflight += 1
+        # chaos point: an armed gateway.lane.kill (typically matched to
+        # one lane index) fails requests routed here mid-flight; the
+        # pool's retry-to-another-lane + success-corroborated health
+        # charging must absorb it exactly like a real lane fault. The
+        # raise sits AFTER the inflight increment so the router's
+        # release() stays balanced. Unarmed: the armed() gate is one
+        # attribute read, and the ctx dict is never even built.
+        if faults.armed() and faults.fire(
+            "gateway.lane.kill", {"lane": self.index}
+        ) is not None:
+            raise faults.FaultInjected("gateway.lane.kill", lane=self.index)
         return self.batcher.submit(example, parent_span_id=parent_span_id)
 
     def release(self) -> None:

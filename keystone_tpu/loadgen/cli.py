@@ -1,0 +1,269 @@
+"""``python -m keystone_tpu serve-loadgen`` — the experiment driver.
+
+Replays a workload (recorded ``--trace`` JSONL or ``--synthetic``)
+open-loop against a gateway (``--target URL``, or ``--self-gateway``
+to stand one up in-process over the bench pipeline), optionally arms
+a chaos timeline mid-run (``--fault``, armed over ``POST /chaosz``
+for HTTP targets so the fault fires in the SERVER process), runs the
+invariant checker over the result, prints the structured verdict, and
+exits nonzero when the verdict is red.
+
+Examples::
+
+    # replay a recorded trace at 4x against a live gateway
+    python -m keystone_tpu serve-loadgen --target http://127.0.0.1:8000 \\
+        --trace requests.jsonl --speed 4
+
+    # synthetic heavy-tail load with a lane killed mid-run, verdict
+    # must be green
+    python -m keystone_tpu serve-loadgen --target http://127.0.0.1:8000 \\
+        --synthetic 400 --arrivals lognormal --rate 80 \\
+        --fault 'gateway.lane.kill=lane:0' --fault-at 1.5 --fault-for 1.5
+
+    # no server handy: drive an in-process gateway
+    python -m keystone_tpu serve-loadgen --self-gateway --synthetic 200
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from keystone_tpu.loadgen import faults as faults_mod
+from keystone_tpu.loadgen import trace as trace_mod
+from keystone_tpu.loadgen.invariants import (
+    InvariantChecker,
+    InvariantResult,
+)
+from keystone_tpu.loadgen.runner import (
+    FaultPlan,
+    HttpTarget,
+    InprocTarget,
+    LoadGenerator,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="keystone_tpu serve-loadgen",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    tgt = ap.add_argument_group("target")
+    tgt.add_argument("--target", default=None, metavar="URL",
+                     help="base URL of a running gateway frontend")
+    tgt.add_argument("--self-gateway", action="store_true",
+                     help="stand up an in-process gateway over the "
+                     "bench pipeline instead of --target")
+    tgt.add_argument("--d", type=int, default=64,
+                     help="feature dim of the --self-gateway pipeline "
+                     "(and the default replay example shape)")
+    tgt.add_argument("--lanes", type=int, default=2)
+    tgt.add_argument("--buckets", default="4,16")
+
+    wl = ap.add_argument_group("workload")
+    wl.add_argument("--trace", default=None, metavar="FILE",
+                    help="replay this --request-log JSONL recording")
+    wl.add_argument("--no-collapse", action="store_true",
+                    help="replay one request per recorded line instead "
+                    "of collapsing per-instance lines back into their "
+                    "originating POSTs")
+    wl.add_argument("--synthetic", type=int, default=None, metavar="N",
+                    help="synthesize N requests instead of --trace")
+    wl.add_argument("--arrivals", default="poisson",
+                    choices=trace_mod.ARRIVALS)
+    wl.add_argument("--rate", type=float, default=100.0,
+                    help="mean arrival rate, requests/sec")
+    wl.add_argument("--sigma", type=float, default=1.0,
+                    help="lognormal arrival shape")
+    wl.add_argument("--alpha", type=float, default=1.5,
+                    help="pareto arrival tail index (> 1)")
+    wl.add_argument("--size-mix", default="1:1.0", metavar="R:W,...",
+                    help="instance-count mixture, e.g. 1:0.8,4:0.2")
+    wl.add_argument("--deadline-ms", type=float, default=None)
+    wl.add_argument("--deadline-sigma", type=float, default=0.0,
+                    help="lognormal jitter on --deadline-ms")
+    wl.add_argument("--seed", type=int, default=0)
+    wl.add_argument("--speed", type=float, default=1.0,
+                    help="replay speed factor (2 = twice as fast)")
+    wl.add_argument("--settle-s", type=float, default=0.0,
+                    help="keep the run open this long past the last "
+                    "arrival (lets post-fault recovery be measured)")
+    wl.add_argument("--max-outstanding", type=int, default=128)
+
+    ch = ap.add_argument_group("chaos")
+    ch.add_argument("--fault", action="append", default=[],
+                    metavar="POINT[=k:v,...]",
+                    help="arm this fault point mid-run (same grammar "
+                    "as KEYSTONE_FAULTS; repeatable, paired "
+                    "positionally with --fault-at/--fault-for)")
+    ch.add_argument("--fault-at", action="append", type=float,
+                    default=[], metavar="T",
+                    help="seconds into the run to arm the matching "
+                    "--fault (default 0)")
+    ch.add_argument("--fault-for", action="append", type=float,
+                    default=[], metavar="S",
+                    help="clear the matching --fault after S seconds "
+                    "(default: stays armed until the run ends)")
+
+    inv = ap.add_argument_group("invariants")
+    inv.add_argument("--p99-factor", type=float, default=1.5,
+                     help="post-fault p99 must recover to within this "
+                     "factor of the pre-fault p99")
+    inv.add_argument("--recovery-s", type=float, default=10.0,
+                     help="seconds after the fault clears within which "
+                     "p99 (and readiness) must recover")
+    inv.add_argument("--max-shed-rate", type=float, default=None)
+    inv.add_argument("--max-p99-ms", type=float, default=None)
+
+    out = ap.add_argument_group("output")
+    out.add_argument("--report", default=None, metavar="FILE",
+                     help="also write the JSON verdict here")
+    out.add_argument("--no-verdict", action="store_true",
+                     help="replay only; skip invariant checking (exit "
+                     "0 regardless)")
+    return ap
+
+
+def _build_events(args) -> List[trace_mod.TraceEvent]:
+    if (args.trace is None) == (args.synthetic is None):
+        raise SystemExit(
+            "pass exactly one of --trace FILE or --synthetic N"
+        )
+    if args.trace is not None:
+        events = trace_mod.load_trace(
+            args.trace, collapse=not args.no_collapse
+        )
+        if not events:
+            raise SystemExit(
+                f"--trace {args.trace}: no replayable records found"
+            )
+        return events
+    return trace_mod.synthesize(
+        args.synthetic,
+        arrivals=args.arrivals,
+        rate=args.rate,
+        sigma=args.sigma,
+        alpha=args.alpha,
+        size_mix=trace_mod.parse_size_mix(args.size_mix),
+        shape=(args.d,),
+        deadline_ms=args.deadline_ms,
+        deadline_sigma=args.deadline_sigma,
+        seed=args.seed,
+    )
+
+
+def _build_fault_plans(args) -> List[FaultPlan]:
+    plans = []
+    for i, clause in enumerate(args.fault):
+        spec = faults_mod.parse_fault_spec(clause)
+        at = args.fault_at[i] if i < len(args.fault_at) else 0.0
+        dur = args.fault_for[i] if i < len(args.fault_for) else None
+        plans.append(FaultPlan(spec=spec, at_s=at, for_s=dur))
+    return plans
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    events = _build_events(args)
+    print(
+        json.dumps({"workload": trace_mod.summarize(events)}),
+        flush=True,
+    )
+
+    gateway = None
+    if args.self_gateway:
+        import jax.numpy as jnp
+
+        from keystone_tpu.gateway import Gateway
+        from keystone_tpu.serving.bench import build_pipeline
+
+        fitted = build_pipeline(d=args.d, hidden=args.d, depth=2)
+        gateway = Gateway(
+            fitted,
+            buckets=tuple(int(b) for b in args.buckets.split(",")),
+            n_lanes=args.lanes,
+            warmup_example=jnp.zeros((args.d,), jnp.float32),
+            name="loadgen",
+        )
+        target = InprocTarget(gateway, default_shape=(args.d,))
+    elif args.target:
+        target = HttpTarget(args.target, default_shape=(args.d,))
+    else:
+        raise SystemExit("pass --target URL or --self-gateway")
+    # env-armed faults (KEYSTONE_FAULTS) arm AFTER the gateway exists:
+    # trigger points disarm instantly when nothing has registered for
+    # them, so arming earlier would silently no-op gateway.swap.force
+    faults_mod.arm_from_env()
+
+    plans = _build_fault_plans(args)
+    settle = args.settle_s
+    if plans and settle == 0.0:
+        # recovery can only be asserted on traffic that ARRIVES after
+        # the fault clears; warn rather than silently under-measure
+        print(
+            json.dumps({
+                "note": "faults armed with --settle-s 0; if the trace "
+                "ends before the fault clears, recovery has no "
+                "traffic to measure"
+            }),
+            flush=True,
+        )
+    gen = LoadGenerator(target, max_outstanding=args.max_outstanding)
+    # snapshot lifetime fire counts so a green verdict can never mean
+    # "the fault silently failed to arm/fire and nothing was tested"
+    fault_points = sorted({p.spec["point"] for p in plans})
+    fired_before = {p: target.fired_count(p) for p in fault_points}
+    try:
+        report = gen.run(
+            events,
+            speed=args.speed,
+            faults=plans,
+            recovery_probe_s=args.recovery_s,
+            settle_s=settle,
+        )
+        fired_after = {p: target.fired_count(p) for p in fault_points}
+    finally:
+        if gateway is not None:
+            gateway.close()
+
+    if args.no_verdict:
+        print(json.dumps({"stats": report.stats()}, indent=1))
+        return 0
+    checker = InvariantChecker(
+        p99_factor=args.p99_factor,
+        recovery_within_s=args.recovery_s,
+        max_shed_rate=args.max_shed_rate,
+        max_p99_s=(
+            args.max_p99_ms / 1e3 if args.max_p99_ms is not None else None
+        ),
+    )
+    verdict = checker.check(report)
+    for point in fault_points:
+        before, after = fired_before[point], fired_after[point]
+        fired = (
+            None if before is None or after is None else after - before
+        )
+        ok = fired is None or fired > 0
+        verdict.invariants.append(InvariantResult(
+            "requested_fault_actually_fired", ok,
+            f"{point}: "
+            + (f"{fired} injection(s)" if fired is not None
+               else "fire count unavailable (taken on trust)"),
+        ))
+        if not ok:
+            # an unfired fault means the run proved nothing — red
+            verdict.passed = False
+        verdict.stats.setdefault("injections", {})[point] = fired
+    doc = verdict.to_json(indent=1)
+    print(doc, flush=True)
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            f.write(doc + "\n")
+    return 0 if verdict.passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,7 @@ import urllib.error
 import urllib.request
 from typing import Any, Deque, Dict, List, Optional, Sequence
 
+from keystone_tpu.loadgen import faults
 from keystone_tpu.observability.tracing import Span, Tracer, get_tracer
 
 logger = logging.getLogger(__name__)
@@ -211,6 +212,18 @@ class OtlpSpanExporter:
             self._post(batch)
 
     def _post(self, batch: List[Span]) -> None:
+        # chaos point: black-hole the collector. Dropping BEFORE the
+        # POST (counted under result="blackhole") proves the serving
+        # path's telemetry isolation without paying connect/timeout
+        # stalls on the flush thread — the experiment's question is
+        # "does a dead collector cost traffic anything", and the
+        # answer must be visible on /metrics, not in wall time.
+        if faults.armed() and faults.fire(
+            "otlp.export.blackhole", {"endpoint": self.endpoint}
+        ) is not None:
+            self._posts.inc(("blackhole",))
+            self._spans.inc(("dropped",), by=len(batch))
+            return
         body = json.dumps(
             encode_spans(batch, self.service_name)
         ).encode("utf-8")

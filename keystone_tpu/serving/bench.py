@@ -38,6 +38,16 @@
   hardware peaks are known (``KEYSTONE_PEAK_FLOPS`` /
   ``KEYSTONE_PEAK_MEMBW_GBPS`` override for unlisted hardware; without
   peaks those fields report null — never fabricated zeros).
+- ``serving_chaos_lane_kill`` / ``serving_chaos_prep_stall`` — the
+  chaos-harness regression rows (``--chaos``; run by
+  ``bin/smoke-chaos.sh``): sustained open-loop load through a full
+  gateway while a fault point fires mid-window (one lane killed /
+  the pipelined host-prep stage stalled), with the
+  ``loadgen/invariants.py`` verdict ASSERTED in the row — every
+  admitted request resolves, failures are typed sheds only (zero
+  untyped 500s), readiness recovers once the fault clears, and p99
+  returns to within 1.5x the pre-fault value within 10 s of the
+  fault clearing. The headline value is the post/pre p99 ratio.
 
 Callable standalone (``python -m keystone_tpu serve-bench``) or from
 the repo-level ``bench.py`` which passes its own ``emit`` so rows land
@@ -593,12 +603,159 @@ def bench_goodput_mfu(
     )
 
 
+def _run_chaos_experiment(
+    fitted, buckets, d, *, fault_spec, rate, n_requests,
+    fault_at_s, fault_for_s, settle_s, pipeline_depth=2,
+    max_shed_rate=0.9, name="bench-chaos",
+):
+    """One chaos experiment over a full gateway: open-loop synthetic
+    load, the fault armed mid-run, verdict from the invariant checker.
+    Returns (verdict, report, injections)."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.gateway import Gateway
+    from keystone_tpu.loadgen import faults, synthesize
+    from keystone_tpu.loadgen.invariants import InvariantChecker
+    from keystone_tpu.loadgen.runner import (
+        FaultPlan,
+        InprocTarget,
+        LoadGenerator,
+    )
+
+    point = fault_spec["point"]
+    fired_before = faults.get_injector().fired_count(point)
+    events = synthesize(
+        n_requests, arrivals="poisson", rate=rate, shape=(d,), seed=11
+    )
+    with Gateway(
+        fitted, buckets=buckets, n_lanes=2, max_delay_ms=2.0,
+        pipeline_depth=pipeline_depth,
+        warmup_example=jnp.zeros((d,), jnp.float32),
+        name=name,
+    ) as gw:
+        gen = LoadGenerator(InprocTarget(gw, default_shape=(d,)))
+        report = gen.run(
+            events,
+            faults=[FaultPlan(
+                spec=fault_spec, at_s=fault_at_s, for_s=fault_for_s,
+            )],
+            settle_s=settle_s,
+            recovery_probe_s=10.0,
+        )
+    verdict = InvariantChecker(
+        p99_factor=1.5, recovery_within_s=10.0,
+        max_shed_rate=max_shed_rate,
+    ).check(report)
+    injections = faults.get_injector().fired_count(point) - fired_before
+    return verdict, injections
+
+
+def _emit_chaos_row(emit, metric, verdict, injections, extra):
+    # explicit raises, not asserts: a `python -O` run must not strip
+    # the row's whole reason for existing and emit "green" unchecked
+    if injections <= 0:
+        raise RuntimeError(
+            f"{metric}: the fault point never fired — the experiment "
+            "proved nothing"
+        )
+    if not verdict.passed:
+        raise RuntimeError(
+            f"{metric}: serving invariants violated under chaos:\n"
+            + verdict.to_json()
+        )
+    stats = verdict.stats
+    pre = stats.get("pre_fault_p99_ms")
+    # headline = recovered steady-state over pre-fault (the whole
+    # post-window p99 rides in extra; it includes the backlog drain
+    # right after the fault clears, which the recovery invariant
+    # deliberately slides past)
+    post = stats.get("recovered_p99_ms")
+    if post is None:
+        post = stats.get("post_fault_p99_ms")
+    ratio = (
+        round(post / pre, 3) if pre and post is not None else None
+    )
+    emit(
+        metric, ratio, "p99_post_over_pre",
+        extra={
+            "verdict": "green" if verdict.passed else "red",
+            "invariants": [r.name for r in verdict.invariants],
+            "injections": injections,
+            "requests": stats["issued"],
+            "resolved": stats["resolved"],
+            "untyped_failures": stats["untyped_failures"],
+            "lost": stats["lost"],
+            "shed_rate": stats["shed_rate"],
+            "pre_fault_p99_ms": pre,
+            "during_fault_p99_ms": stats.get("during_fault_p99_ms"),
+            "post_fault_p99_ms": stats.get("post_fault_p99_ms"),
+            "recovered_p99_ms": stats.get("recovered_p99_ms"),
+            "p99_recovery_s": stats.get("p99_recovery_s"),
+            "ready_recovery_s": (
+                round(stats["ready_recovery_s"], 2)
+                if stats.get("ready_recovery_s") is not None else None
+            ),
+            **extra,
+        },
+    )
+
+
+def bench_chaos_lane_kill(
+    emit, fitted, buckets: Sequence[int], d: int,
+    n_requests: int = 256, rate: float = 50.0,
+) -> None:
+    """``serving_chaos_lane_kill`` — sustained open-loop load with one
+    lane KILLED mid-window (``gateway.lane.kill`` matched to lane 0
+    for 1.5 s): the pool's retry + success-corroborated health
+    charging must absorb every injected failure. Asserted: zero
+    untyped failures, every admitted request resolves, readiness
+    holds, p99 recovers to within 1.5x pre-fault within 10 s of the
+    fault clearing."""
+    verdict, injections = _run_chaos_experiment(
+        fitted, buckets, d,
+        fault_spec={"point": "gateway.lane.kill", "match": {"lane": 0}},
+        rate=rate, n_requests=n_requests,
+        fault_at_s=1.5, fault_for_s=1.5, settle_s=2.0,
+        name="bench-chaos-kill",
+    )
+    _emit_chaos_row(
+        emit, "serving_chaos_lane_kill", verdict, injections,
+        {"fault": "gateway.lane.kill lane=0 for 1.5s"},
+    )
+
+
+def bench_chaos_prep_stall(
+    emit, fitted, buckets: Sequence[int], d: int,
+    n_requests: int = 256, rate: float = 50.0,
+    stall_ms: float = 40.0,
+) -> None:
+    """``serving_chaos_prep_stall`` — the pipelined lanes' host-prep
+    stage stalled ``stall_ms`` per window for 1.5 s mid-run
+    (``pipeline.host_prep.stall``): latency degrades and backpressure
+    may shed (typed!), but nothing is lost, nothing 500s, and the
+    tail recovers once the stall clears."""
+    verdict, injections = _run_chaos_experiment(
+        fitted, buckets, d,
+        fault_spec={
+            "point": "pipeline.host_prep.stall", "delay_ms": stall_ms,
+        },
+        rate=rate, n_requests=n_requests,
+        fault_at_s=1.5, fault_for_s=1.5, settle_s=2.0,
+        name="bench-chaos-stall",
+    )
+    _emit_chaos_row(
+        emit, "serving_chaos_prep_stall", verdict, injections,
+        {"fault": f"pipeline.host_prep.stall {stall_ms}ms for 1.5s"},
+    )
+
+
 def run_serving_benches(
     emit,
     d: int = 256,
     hidden: int = 512,
     depth: int = 4,
     buckets: Sequence[int] = (8, 32, 128),
+    chaos: bool = False,
 ) -> None:
     fitted = build_pipeline(d, hidden, depth)
     bench_cold_vs_warm(emit, fitted, buckets, d)
@@ -608,6 +765,27 @@ def run_serving_benches(
     bench_swap_blip(emit, fitted, buckets, d)
     bench_pipeline_overlap(emit, fitted, buckets, d)
     bench_goodput_mfu(emit, fitted, buckets, d)
+    if chaos:
+        run_chaos_benches(emit, d=d, hidden=hidden, depth=depth,
+                          buckets=buckets, fitted=fitted)
+
+
+def run_chaos_benches(
+    emit,
+    d: int = 256,
+    hidden: int = 512,
+    depth: int = 4,
+    buckets: Sequence[int] = (8, 32, 128),
+    fitted=None,
+) -> None:
+    """The chaos rows alone (bin/smoke-chaos.sh's entry; each row is
+    a ~10 s sustained-load experiment, so they're opt-in). Callers
+    that already built the bench pipeline pass it via ``fitted`` —
+    a second fit + warm-compile would waste seconds for nothing."""
+    if fitted is None:
+        fitted = build_pipeline(d, hidden, depth)
+    bench_chaos_lane_kill(emit, fitted, buckets, d)
+    bench_chaos_prep_stall(emit, fitted, buckets, d)
 
 
 def main(argv=None) -> int:
@@ -628,6 +806,14 @@ def main(argv=None) -> int:
                     help="number of matmul nodes in the bench pipeline")
     ap.add_argument("--no-cache", action="store_true",
                     help="skip persistent-compile-cache setup")
+    ap.add_argument("--chaos", action="store_true",
+                    help="also run the chaos rows (serving_chaos_"
+                    "lane_kill / serving_chaos_prep_stall): sustained "
+                    "open-loop load with a fault injected mid-run, "
+                    "invariant verdict asserted (~10s each)")
+    ap.add_argument("--chaos-only", action="store_true",
+                    help="run ONLY the chaos rows (what "
+                    "bin/smoke-chaos.sh invokes)")
     ap.add_argument("--profile-dir", default=None, metavar="DIR",
                     help="wrap the whole bench run in a jax.profiler "
                     "trace written to DIR (open in Perfetto or "
@@ -650,10 +836,16 @@ def main(argv=None) -> int:
         print(json.dumps(row), flush=True)
 
     def run():
-        run_serving_benches(
-            emit, d=args.d, hidden=args.hidden, depth=args.depth,
-            buckets=buckets,
-        )
+        if args.chaos_only:
+            run_chaos_benches(
+                emit, d=args.d, hidden=args.hidden, depth=args.depth,
+                buckets=buckets,
+            )
+        else:
+            run_serving_benches(
+                emit, d=args.d, hidden=args.hidden, depth=args.depth,
+                buckets=buckets, chaos=args.chaos,
+            )
 
     if args.profile_dir:
         from keystone_tpu.utils.profiling import trace

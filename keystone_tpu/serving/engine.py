@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from keystone_tpu.loadgen import faults
 from keystone_tpu.observability import device as device_obs
 from keystone_tpu.observability.tracing import get_tracer
 from keystone_tpu.parallel import mesh as mesh_lib
@@ -247,6 +248,17 @@ class CompiledPipeline:
         XLA where the backend supports donation. Returns the full
         padded output (async; callers slice to ``rows`` valid rows and
         own the sync point)."""
+        # chaos point: fail the whole window at dispatch (match:
+        # engine=<name> to target one lane's engine). Serial apply and
+        # the pipelined compute stage both pass through here, so the
+        # experiment exercises whichever path traffic does. Unarmed:
+        # the armed() gate keeps this a no-op (no ctx dict built).
+        if faults.armed() and faults.fire(
+            "engine.dispatch.error", {"engine": self.name}
+        ) is not None:
+            raise faults.FaultInjected(
+                "engine.dispatch.error", engine=self.name, bucket=bucket
+            )
         out = self._fn(bucket)(staged)
         self.metrics.record_dispatch(bucket, rows)
         return out

@@ -64,6 +64,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from keystone_tpu.loadgen import faults
 from keystone_tpu.observability.tracing import get_tracer
 
 logger = logging.getLogger(__name__)
@@ -367,6 +368,17 @@ class LanePipeline:
     # pooled staging buffer
     def _host_prep(self, w: _Window) -> None:
         engine = w.engine
+        # chaos point: stall the prep stage (a slow tokenizer RPC /
+        # feature-store brownout). The sleep holds THIS stage thread,
+        # so the bounded handoff queues fill, submit_window blocks,
+        # lane load rises, and admission sheds — the end-to-end
+        # backpressure chain is exactly what the experiment verifies.
+        if faults.armed():
+            spec = faults.fire(
+                "pipeline.host_prep.stall", {"engine": engine.name}
+            )
+            if spec is not None and spec.delay_ms > 0:
+                time.sleep(spec.delay_ms / 1e3)
         w.tree, w.owned = self._assemble(w.examples)
         w.examples = None  # window owns the batched tree from here
         leaves, treedef = jax.tree_util.tree_flatten(w.tree)

@@ -1,0 +1,386 @@
+"""The fault-injection plane: deterministic arm/fire/clear semantics,
+the unarmed-is-a-no-op hot-path contract, env arming, triggers, and
+each WIRED fault point firing in the real code path it claims to."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu.loadgen import faults
+from keystone_tpu.loadgen.faults import (
+    FAULT_POINTS,
+    FaultInjected,
+    FaultInjector,
+)
+
+from gateway_fixtures import D, batch, make_fitted, reference
+
+
+# -- injector semantics ----------------------------------------------------
+
+
+def test_unarmed_fire_is_none_and_armed_flag_false():
+    inj = FaultInjector()
+    assert inj.armed is False
+    assert inj.fire("anything") is None
+
+
+def test_unarmed_hot_path_does_no_slow_work():
+    """The no-op contract: with nothing armed, fire() never reaches
+    the slow path — asserted with a counting stub standing in for
+    _fire_slow (the first thing that would lock/allocate)."""
+    inj = FaultInjector()
+    calls = [0]
+
+    def counting_stub(point, ctx):
+        calls[0] += 1
+        return None
+
+    inj._fire_slow = counting_stub
+    for _ in range(10_000):
+        assert inj.fire("gateway.lane.kill") is None
+    assert calls[0] == 0, (
+        f"unarmed fire() reached the slow path {calls[0]} times"
+    )
+    # arming flips the gate: the same call now consults the stub
+    inj.armed = True
+    inj.fire("gateway.lane.kill")
+    assert calls[0] == 1
+
+
+def test_armed_gate_tracks_injector_state():
+    """The wired call sites guard with faults.armed() so the unarmed
+    path never even builds a ctx dict; the gate must track arming
+    exactly."""
+    assert faults.armed() is False
+    faults.arm("gate.point", count=1)
+    assert faults.armed() is True
+    faults.fire("gate.point")  # count exhausted -> auto-disarm
+    assert faults.armed() is False
+    faults.arm("gate.point")
+    faults.disarm("gate.point")
+    assert faults.armed() is False
+
+
+def test_global_unarmed_fire_skips_slow_path_too():
+    inj = faults.get_injector()
+    orig = inj._fire_slow
+    calls = [0]
+
+    def counting_stub(point, ctx):
+        calls[0] += 1
+        return orig(point, ctx)
+
+    inj._fire_slow = counting_stub
+    try:
+        for _ in range(1000):
+            faults.fire("engine.dispatch.error")
+        assert calls[0] == 0
+    finally:
+        inj._fire_slow = orig
+
+
+def test_count_bounds_fires_and_auto_disarms():
+    inj = FaultInjector()
+    inj.arm("p", count=2)
+    assert inj.fire("p") is not None
+    assert inj.fire("p") is not None
+    assert inj.fire("p") is None  # exhausted
+    assert inj.armed is False     # gate dropped with the last spec
+    assert inj.fired_count("p") == 2
+
+
+def test_for_s_expires_the_spec():
+    inj = FaultInjector()
+    inj.arm("p", for_s=0.05)
+    assert inj.fire("p") is not None
+    time.sleep(0.1)
+    assert inj.fire("p") is None
+    assert "p" not in inj.status()["armed"]
+
+
+def test_match_filters_by_context():
+    inj = FaultInjector()
+    inj.arm("p", match={"lane": 0})
+    assert inj.fire("p", {"lane": 1}) is None
+    assert inj.fire("p") is None          # no ctx can't match
+    assert inj.fire("p", {"lane": 0}) is not None
+
+
+def test_disarm_and_disarm_all():
+    inj = FaultInjector()
+    inj.arm("a")
+    inj.arm("b")
+    assert inj.disarm("a") is True
+    assert inj.disarm("a") is False
+    assert inj.armed is True
+    inj.disarm_all()
+    assert inj.armed is False
+    assert inj.fire("b") is None
+
+
+def test_rearm_replaces_spec():
+    inj = FaultInjector()
+    inj.arm("p", count=1)
+    inj.arm("p", count=5)  # replaces; fired resets on the new spec
+    for _ in range(5):
+        assert inj.fire("p") is not None
+    assert inj.fire("p") is None
+
+
+def test_status_surfaces_catalog_armed_and_fired():
+    inj = FaultInjector()
+    inj.arm("gateway.lane.kill", count=3, match={"lane": 1})
+    inj.fire("gateway.lane.kill", {"lane": 1})
+    doc = inj.status()
+    assert set(doc["points"]) == set(FAULT_POINTS)
+    armed = doc["armed"]["gateway.lane.kill"]
+    assert armed["count"] == 3 and armed["fired"] == 1
+    assert armed["match"] == {"lane": 1}
+    assert doc["fired_total"]["gateway.lane.kill"] == 1
+
+
+def test_injection_counter_on_global_registry():
+    from keystone_tpu.observability.registry import get_global_registry
+
+    counter = get_global_registry().counter(
+        "keystone_fault_injections_total",
+        "chaos fault-point fires, by point",
+        ("point",),
+    )
+    before = counter.get(("test.counter.point",))
+    faults.arm("test.counter.point", count=2)
+    faults.fire("test.counter.point")
+    faults.fire("test.counter.point")
+    assert counter.get(("test.counter.point",)) == before + 2
+
+
+# -- env arming ------------------------------------------------------------
+
+
+def test_parse_fault_spec_grammar():
+    kw = faults.parse_fault_spec("a.b=count:3,delay_ms:7.5,for_s:2,lane:0")
+    assert kw == {
+        "point": "a.b", "count": 3, "delay_ms": 7.5, "for_s": 2.0,
+        "match": {"lane": 0},
+    }
+    assert faults.parse_fault_spec("bare.point") == {"point": "bare.point"}
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec("p=notakv")
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec("")
+
+
+def test_arm_from_env_arms_each_clause():
+    specs = faults.arm_from_env(
+        {"KEYSTONE_FAULTS": "env.a=count:2 env.b=delay_ms:5,engine:x"}
+    )
+    assert [s.point for s in specs] == ["env.a", "env.b"]
+    inj = faults.get_injector()
+    assert inj.fire("env.a") is not None
+    assert inj.fire("env.b", {"engine": "x"}).delay_ms == 5.0
+    assert faults.arm_from_env({}) == []  # absent env: no-op
+
+
+# -- triggers --------------------------------------------------------------
+
+
+def test_trigger_runs_on_arm_and_unregister_stops_it():
+    inj = FaultInjector()
+    ran = threading.Event()
+    seen = []
+
+    def trig(spec):
+        seen.append(spec.point)
+        ran.set()
+
+    unregister = inj.register_trigger("t.point", trig, ctx={"g": "a"})
+    inj.arm("t.point")
+    assert ran.wait(2.0), "trigger never ran"
+    assert seen == ["t.point"]
+    assert inj.fired_count("t.point") == 1
+    # trigger points are one-shot per arm: the spec auto-disarms once
+    # the callbacks ran, so the hot-path gate doesn't stay pinned True
+    deadline = time.perf_counter() + 2.0
+    while inj.armed and time.perf_counter() < deadline:
+        time.sleep(0.01)
+    assert not inj.armed, "trigger spec stayed armed after firing"
+    unregister()
+    ran.clear()
+    inj.arm("t.point")
+    time.sleep(0.1)
+    assert not ran.is_set(), "unregistered trigger still ran"
+
+
+def test_trigger_match_filters_on_registration_ctx():
+    inj = FaultInjector()
+    ran = threading.Event()
+    inj.register_trigger("t.m", lambda s: ran.set(), ctx={"g": "a"})
+    inj.arm("t.m", match={"g": "OTHER"})
+    time.sleep(0.1)
+    assert not ran.is_set()
+    inj.arm("t.m", match={"g": "a"})
+    assert ran.wait(2.0)
+
+
+# -- the wired fault points fire in their real code paths ------------------
+
+
+def test_engine_dispatch_error_fires_and_clears(fitted):
+    engine = fitted.compiled(buckets=(4, 8), name="chaos-engine")
+    xs = batch(3, seed=7)
+    want = reference(fitted, xs)
+    np.testing.assert_allclose(
+        np.asarray(engine.apply(xs, sync=True)), want,
+        rtol=1e-4, atol=1e-5,
+    )
+    faults.arm(
+        "engine.dispatch.error", match={"engine": "chaos-engine"},
+        count=1,
+    )
+    with pytest.raises(FaultInjected):
+        engine.apply(xs, sync=True)
+    # count=1 auto-disarmed: the next dispatch is healthy again
+    np.testing.assert_allclose(
+        np.asarray(engine.apply(xs, sync=True)), want,
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_engine_dispatch_error_match_spares_other_engines(fitted):
+    target = fitted.compiled(buckets=(4, 8), name="chaos-target")
+    other = fitted.compiled(buckets=(4, 8), name="chaos-other")
+    xs = batch(2, seed=8)
+    faults.arm(
+        "engine.dispatch.error", match={"engine": "chaos-target"}
+    )
+    with pytest.raises(FaultInjected):
+        target.apply(xs, sync=True)
+    # the unmatched engine is untouched while the point stays armed
+    np.testing.assert_allclose(
+        np.asarray(other.apply(xs, sync=True)),
+        reference(fitted, xs), rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_lane_kill_is_absorbed_by_pool_retry(fitted):
+    """gateway.lane.kill matched to lane 0: requests route, die on
+    lane 0, retry on lane 1, and resolve CORRECTLY — the caller never
+    sees the fault."""
+    from keystone_tpu.gateway.pool import EnginePool
+
+    pool = EnginePool(
+        lambda name: fitted.compiled(buckets=(4, 8), name=name),
+        2, name="chaos-pool", max_delay_ms=1.0,
+    )
+    try:
+        faults.arm("gateway.lane.kill", match={"lane": 0})
+        xs = batch(6, seed=9)
+        want = reference(fitted, xs)
+        futures = [pool.submit(x) for x in xs]
+        for i, f in enumerate(futures):
+            np.testing.assert_allclose(
+                np.asarray(f.result(timeout=30)), want[i],
+                rtol=1e-4, atol=1e-5,
+            )
+        assert faults.get_injector().fired_count("gateway.lane.kill") > 0
+    finally:
+        faults.disarm_all()
+        pool.close()
+
+
+def test_host_prep_stall_delays_but_stays_correct(fitted):
+    from keystone_tpu.serving.batching import MicroBatcher
+
+    engine = fitted.compiled(buckets=(4, 8), name="chaos-stall")
+    engine.warmup(example=np.zeros(D, np.float32))
+    xs = batch(4, seed=10)
+    want = reference(fitted, xs)
+    with MicroBatcher(
+        engine, max_delay_ms=1.0, pipeline_depth=2
+    ) as mb:
+        # unarmed pass warms the staged path
+        for i, f in enumerate([mb.submit(x) for x in xs]):
+            np.testing.assert_allclose(
+                np.asarray(f.result(timeout=30)), want[i],
+                rtol=1e-4, atol=1e-5,
+            )
+        faults.arm("pipeline.host_prep.stall", delay_ms=30.0, count=1)
+        t0 = time.perf_counter()
+        futures = [mb.submit(x) for x in xs]
+        for i, f in enumerate(futures):
+            np.testing.assert_allclose(
+                np.asarray(f.result(timeout=30)), want[i],
+                rtol=1e-4, atol=1e-5,
+            )
+        assert time.perf_counter() - t0 >= 0.03, (
+            "the stall point never stalled"
+        )
+    assert (
+        faults.get_injector().fired_count("pipeline.host_prep.stall") >= 1
+    )
+
+
+def test_otlp_blackhole_drops_batches_without_posting():
+    from keystone_tpu.observability.otlp import OtlpSpanExporter
+    from keystone_tpu.observability.tracing import Span
+
+    exporter = OtlpSpanExporter(
+        # a port nothing listens on: if blackhole failed to intercept,
+        # the POST path would count result="error" instead
+        "http://127.0.0.1:9/v1/traces",
+        batch_size=2, flush_interval_s=60.0,
+    )
+    faults.arm("otlp.export.blackhole")
+    span = Span(
+        name="s", span_id=1, parent_id=None, start_s=0.0,
+        duration_s=0.001, thread_id=0, attrs={},
+    )
+    exporter.submit(span)
+    exporter.submit(span)
+    exporter._flush_once()
+    assert exporter._posts.get(("blackhole",)) == 1
+    assert exporter._posts.get(("error",)) == 0
+    assert exporter._spans.get(("dropped",)) >= 2
+    assert faults.get_injector().fired_count("otlp.export.blackhole") == 1
+
+
+def test_swap_force_trigger_forces_a_live_rebucket(fitted):
+    import jax.numpy as jnp
+
+    from keystone_tpu.gateway import Gateway
+
+    gw = Gateway(
+        fitted, buckets=(4, 8), n_lanes=1, max_delay_ms=1.0,
+        warmup_example=jnp.zeros(D, jnp.float32),
+        name="chaos-swap-gw",
+    )
+    try:
+        assert gw.metrics.swap_count() == 0
+        faults.arm("gateway.swap.force", match={"gateway": "chaos-swap-gw"})
+        deadline = time.perf_counter() + 30
+        while (
+            gw.metrics.swap_count() == 0
+            and time.perf_counter() < deadline
+        ):
+            time.sleep(0.05)
+        assert gw.metrics.swap_count() == 1, (
+            "arming gateway.swap.force never forced a swap"
+        )
+        # traffic still serves across the chaos-forced swap
+        xs = batch(2, seed=11)
+        for i, f in enumerate([gw.predict(x) for x in xs]):
+            np.testing.assert_allclose(
+                np.asarray(f.result(timeout=30)),
+                reference(fitted, xs)[i], rtol=1e-4, atol=1e-5,
+            )
+    finally:
+        faults.disarm_all()
+        gw.close()
+    # close() unregistered the trigger: re-arming swaps nothing
+    swaps = gw.metrics.swap_count()
+    faults.arm("gateway.swap.force", match={"gateway": "chaos-swap-gw"})
+    time.sleep(0.2)
+    assert gw.metrics.swap_count() == swaps

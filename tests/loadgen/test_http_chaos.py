@@ -1,0 +1,253 @@
+"""HTTP-surface satellites: /chaosz arm/disarm round-trips over the
+wire, the file-backed --request-log records replayable lines, and a
+recorded log round-trips through the loadgen parser back into the
+same requests."""
+
+import itertools
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from keystone_tpu.gateway import Gateway, GatewayServer
+from keystone_tpu.loadgen import faults, trace
+
+from gateway_fixtures import D, batch, make_fitted
+
+_ids = itertools.count()
+
+
+@pytest.fixture
+def served(tmp_path):
+    fitted = make_fitted()
+    gw = Gateway(
+        fitted,
+        buckets=(4, 8),
+        n_lanes=2,
+        max_delay_ms=2.0,
+        warmup_example=np.zeros(D, np.float32),
+        name=f"chaos-http{next(_ids)}",
+    )
+    log_path = tmp_path / "requests.jsonl"
+    srv = GatewayServer(gw, port=0, request_log=str(log_path)).start()
+    yield gw, srv, log_path
+    gw.close()
+    srv.stop()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(srv.url(path), timeout=15) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _post(srv, path, doc):
+    req = urllib.request.Request(
+        srv.url(path),
+        data=json.dumps(doc).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+# -- /chaosz ---------------------------------------------------------------
+
+
+def test_chaosz_get_lists_catalog(served):
+    _, srv, _ = served
+    status, doc = _get(srv, "/chaosz")
+    assert status == 200
+    assert "gateway.lane.kill" in doc["points"]
+    assert doc["armed"] == {}
+
+
+def test_chaosz_arm_disarm_round_trip(served):
+    _, srv, _ = served
+    fired_before = faults.get_injector().fired_count(
+        "pipeline.host_prep.stall"
+    )
+    status, doc = _post(srv, "/chaosz", {
+        "arm": {
+            "point": "pipeline.host_prep.stall",
+            "delay_ms": 5, "count": 3, "match": {"engine": "x"},
+        },
+    })
+    assert status == 200
+    armed = doc["armed"]["pipeline.host_prep.stall"]
+    assert armed["count"] == 3
+    assert armed["delay_ms"] == 5
+    assert armed["match"] == {"engine": "x"}
+    # the arm landed on the PROCESS-global injector (what the hot
+    # paths consult), not some HTTP-local state
+    assert (
+        faults.get_injector().fire(
+            "pipeline.host_prep.stall", {"engine": "x"}
+        ) is not None
+    )
+    status, doc = _post(
+        srv, "/chaosz", {"disarm": "pipeline.host_prep.stall"}
+    )
+    assert doc["armed"] == {}
+    # fired_total is a lifetime audit (kept across disarms — and so
+    # across tests in one process): assert the delta
+    assert (
+        doc["fired_total"]["pipeline.host_prep.stall"]
+        == fired_before + 1
+    )
+
+
+def test_chaosz_disarm_star_clears_everything(served):
+    _, srv, _ = served
+    _post(srv, "/chaosz", {"arm": {"point": "gateway.lane.kill"}})
+    _post(srv, "/chaosz", {"arm": {"point": "engine.dispatch.error"}})
+    _, doc = _post(srv, "/chaosz", {"disarm": "*"})
+    assert doc["armed"] == {}
+    assert not faults.get_injector().armed
+
+
+def test_chaosz_rejects_unknown_point_and_bad_body(served):
+    _, srv, _ = served
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(srv, "/chaosz", {"arm": {"point": "not.a.point"}})
+    assert e.value.code == 400
+    doc = json.loads(e.value.read())
+    assert doc["error"] == "unknown_fault_point"
+    assert "gateway.lane.kill" in doc["known"]
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(srv, "/chaosz", {"neither": 1})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(srv, "/chaosz", {"arm": {"point": "gateway.lane.kill",
+                                       "count": 0}})
+    assert e.value.code == 400
+
+
+def test_chaosz_armed_kill_still_serves_typed(served):
+    """Arm a lane kill over HTTP, then predict: the pool retries to
+    the healthy lane and the client sees a clean 200."""
+    _, srv, _ = served
+    _post(srv, "/chaosz", {
+        "arm": {"point": "gateway.lane.kill", "match": {"lane": 0},
+                "for_s": 30.0},
+    })
+    xs = batch(4, seed=21)
+    status, doc = _post(srv, "/predict", {"instances": xs.tolist()})
+    assert status == 200
+    assert len(doc["predictions"]) == 4
+    _post(srv, "/chaosz", {"disarm": "*"})
+
+
+def test_chaos_routes_can_be_disabled():
+    """chaos_routes=False removes the sabotage surface: /chaosz 404s
+    (both methods) while /predict keeps serving."""
+    fitted = make_fitted()
+    gw = Gateway(
+        fitted, buckets=(4, 8), n_lanes=1, max_delay_ms=1.0,
+        warmup_example=np.zeros(D, np.float32),
+        name=f"chaos-http{next(_ids)}",
+    )
+    srv = GatewayServer(gw, port=0, chaos_routes=False).start()
+    try:
+        for do in (
+            lambda: _get(srv, "/chaosz"),
+            lambda: _post(srv, "/chaosz",
+                          {"arm": {"point": "gateway.lane.kill"}}),
+        ):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                do()
+            assert e.value.code == 404
+            assert json.loads(e.value.read())["error"] == (
+                "chaos_routes_disabled"
+            )
+        assert not faults.get_injector().armed
+        status, doc = _post(
+            srv, "/predict", {"instances": [batch(1, seed=30)[0].tolist()]}
+        )
+        assert status == 200 and len(doc["predictions"]) == 1
+    finally:
+        gw.close()
+        srv.stop()
+
+
+# -- file-backed request log + replay round trip ---------------------------
+
+
+def test_request_log_file_records_replayable_lines(served):
+    gw, srv, log_path = served
+    xs = batch(3, seed=22)
+    _post(srv, "/predict", {
+        "instances": xs.tolist(), "deadline_ms": 5000,
+    })
+    _post(srv, "/predict", {"instances": [xs[0].tolist()]})
+    lines = log_path.read_text().strip().splitlines()
+    assert len(lines) == 4  # 3 instances + 1 instance
+    recs = [json.loads(l) for l in lines]
+    assert all(r["status"] == 200 for r in recs)
+    assert [r["n_rows"] for r in recs] == [3, 3, 3, 1]
+    assert all(r["shape"] == [D] for r in recs)
+    assert [r["deadline_ms"] for r in recs] == [5000, 5000, 5000, None]
+    assert all("latency_ms" in r and "ts" in r for r in recs)
+    # one POST's lines share ONE post_seq and ONE (arrival) ts —
+    # replay preserves the arrival pattern, not completion order
+    assert recs[0]["post_seq"] == recs[1]["post_seq"] == recs[2]["post_seq"]
+    assert recs[3]["post_seq"] != recs[0]["post_seq"]
+    assert recs[0]["ts"] == recs[1]["ts"] == recs[2]["ts"]
+
+    # the parser reconstructs the two POSTs, normalized to t=0
+    events = trace.load_trace(str(log_path))
+    assert [e.n_rows for e in events] == [3, 1]
+    assert events[0].shape == (D,)
+    assert events[0].deadline_ms == 5000
+    assert events[0].ts == 0.0
+
+
+def test_request_log_file_records_typed_sheds_with_meta(served):
+    gw, srv, log_path = served
+    gw.close()  # draining: /predict sheds typed 503/closed
+    xs = batch(2, seed=23)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(srv, "/predict", {
+            "instances": xs.tolist(), "deadline_ms": 100,
+        })
+    assert e.value.code == 503
+    recs = [
+        json.loads(l)
+        for l in log_path.read_text().strip().splitlines()
+    ]
+    assert len(recs) == 1  # one line for the whole shed POST
+    assert recs[0]["status"] == 503
+    assert recs[0]["error"] == "closed"
+    # the replay fields survived the error path
+    assert recs[0]["n_rows"] == 2
+    assert recs[0]["shape"] == [D]
+    assert recs[0]["deadline_ms"] == 100
+    # and the parser replays it as one full-size event
+    events = trace.load_trace(str(log_path))
+    assert [e.n_rows for e in events] == [2]
+
+
+def test_request_log_stdout_mode_still_works(capsys):
+    """Bare request_log=True keeps the original stdout behavior."""
+    fitted = make_fitted()
+    gw = Gateway(
+        fitted, buckets=(4, 8), n_lanes=1, max_delay_ms=1.0,
+        warmup_example=np.zeros(D, np.float32),
+        name=f"chaos-http{next(_ids)}",
+    )
+    srv = GatewayServer(gw, port=0, request_log=True).start()
+    try:
+        xs = batch(1, seed=24)
+        _post(srv, "/predict", {"instances": xs.tolist()})
+    finally:
+        gw.close()
+        srv.stop()
+    out = capsys.readouterr().out
+    recs = [
+        json.loads(l) for l in out.strip().splitlines()
+        if l.startswith("{")
+    ]
+    assert len(recs) == 1
+    assert recs[0]["n_rows"] == 1
+    assert recs[0]["shape"] == [D]

@@ -1,0 +1,229 @@
+"""Trace parsing (old + new request-log formats, POST collapsing) and
+synthetic workload generation (arrival processes, size mixtures,
+deadline distributions — all seeded-deterministic)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from keystone_tpu.loadgen import trace
+
+
+def _new_line(ts, n_rows=1, shape=(6,), deadline_ms=None, status=200):
+    return json.dumps({
+        "ts": ts, "path": "/predict", "status": status,
+        "latency_ms": 2.0, "lane": 0, "trace_id": "ab" * 16,
+        "n_rows": n_rows, "shape": list(shape),
+        "deadline_ms": deadline_ms,
+    })
+
+
+def _old_line(ts, status=200):
+    # the pre-loadgen format: no n_rows / shape / deadline_ms
+    return json.dumps({
+        "ts": ts, "path": "/predict", "status": status,
+        "latency_ms": 1.5, "lane": 1, "trace_id": None,
+    })
+
+
+def test_parse_new_format_line():
+    ev = trace.parse_request_log_line(
+        _new_line(12.5, n_rows=3, shape=(4, 2), deadline_ms=50.0)
+    )
+    assert ev.ts == 12.5
+    assert ev.n_rows == 3
+    assert ev.shape == (4, 2)
+    assert ev.deadline_ms == 50.0
+    assert ev.status == 200
+
+
+def test_parse_old_format_tolerated_as_single_instance():
+    ev = trace.parse_request_log_line(_old_line(3.25))
+    assert ev is not None
+    assert ev.n_rows == 1
+    assert ev.shape is None
+    assert ev.deadline_ms is None
+    assert ev.lane == 1
+
+
+def test_parse_skips_non_record_lines():
+    lines = [
+        "gateway: http://127.0.0.1:1234 (POST /predict, ...)",
+        "",
+        "{not json",
+        json.dumps({"no_ts_field": 1}),
+        json.dumps({"ts": 1.0, "path": "/other"}),  # not a predict
+        _old_line(1.0),
+        _new_line(2.0),
+    ]
+    events = trace.parse_request_log(lines)
+    assert len(events) == 2
+
+
+def test_collapse_folds_per_instance_lines_into_posts():
+    # a 3-instance POST logs 3 adjacent lines with n_rows=3
+    lines = [_new_line(1.0, n_rows=3) for _ in range(3)]
+    # then a 1-instance POST
+    lines.append(_new_line(1.2, n_rows=1))
+    # then a SHED 2-instance POST that logged only one line
+    lines.append(_new_line(1.4, n_rows=2, status=429))
+    events = trace.collapse_posts(trace.parse_request_log(lines))
+    assert [e.n_rows for e in events] == [3, 1, 2]
+
+
+def test_collapse_splits_runs_longer_than_n_rows():
+    # two back-to-back 2-instance POSTs: 4 identical-looking lines
+    lines = [_new_line(1.0, n_rows=2) for _ in range(4)]
+    events = trace.collapse_posts(trace.parse_request_log(lines))
+    assert [e.n_rows for e in events] == [2, 2]
+
+
+def test_collapse_dedupes_by_post_seq_despite_interleaving():
+    """Concurrent handler threads interleave their per-instance lines
+    in the file; post_seq (stamped per POST since this subsystem
+    landed) makes collapsing immune to the ordering."""
+    def seq_line(ts, n_rows, seq):
+        doc = json.loads(_new_line(ts, n_rows=n_rows))
+        doc["post_seq"] = seq
+        return json.dumps(doc)
+
+    # a 4-row POST (seq 1) fragmented by a 1-row POST (seq 2)
+    lines = [
+        seq_line(1.0, 4, 1),
+        seq_line(1.0, 4, 1),
+        seq_line(1.001, 1, 2),
+        seq_line(1.001, 4, 1),
+        seq_line(1.002, 4, 1),
+    ]
+    events = trace.collapse_posts(trace.parse_request_log(lines))
+    assert [(e.n_rows, e.post_seq) for e in events] == [(4, 1), (1, 2)]
+
+
+def test_collapse_respects_the_post_window():
+    # same shape/n_rows but seconds apart: different POSTs
+    lines = [_new_line(1.0, n_rows=2), _new_line(3.0, n_rows=2)]
+    events = trace.collapse_posts(trace.parse_request_log(lines))
+    assert len(events) == 2
+
+
+def test_load_trace_no_collapse_is_one_instance_per_line(tmp_path):
+    # keeping n_rows on every per-instance line would replay n_rows^2
+    # instances per POST; --no-collapse means one 1-instance request
+    # per recorded line
+    path = tmp_path / "req.jsonl"
+    path.write_text(
+        "\n".join(_new_line(1.0, n_rows=4) for _ in range(4)) + "\n"
+    )
+    events = trace.load_trace(str(path), collapse=False)
+    assert len(events) == 4
+    assert all(e.n_rows == 1 for e in events)
+
+
+def test_load_trace_round_trip(tmp_path):
+    path = tmp_path / "req.jsonl"
+    path.write_text(
+        "\n".join(
+            ["banner"]
+            + [_new_line(10.0, n_rows=2) for _ in range(2)]
+            + [_new_line(10.5, n_rows=1, deadline_ms=25.0)]
+        ) + "\n"
+    )
+    events = trace.load_trace(str(path))
+    assert [e.n_rows for e in events] == [2, 1]
+    assert events[0].ts == 0.0          # normalized to start at 0
+    assert events[1].ts == pytest.approx(0.5)
+    assert events[1].deadline_ms == 25.0
+
+
+# -- synthesis -------------------------------------------------------------
+
+
+def test_poisson_mean_rate_and_monotone_ts():
+    events = trace.synthesize(
+        4000, arrivals="poisson", rate=200.0, seed=5
+    )
+    ts = np.asarray([e.ts for e in events])
+    assert (np.diff(ts) >= 0).all()
+    assert ts[0] == 0.0
+    mean_gap = float(np.diff(ts).mean())
+    assert mean_gap == pytest.approx(1 / 200.0, rel=0.1)
+
+
+def test_heavy_tail_arrivals_are_heavier_than_poisson():
+    n, rate = 4000, 100.0
+    gaps = {}
+    for arr in ("poisson", "lognormal", "pareto"):
+        events = trace.synthesize(
+            n, arrivals=arr, rate=rate, seed=6, sigma=1.5, alpha=1.2
+        )
+        g = np.diff([e.ts for e in events])
+        # all processes are calibrated to the same mean rate...
+        assert g.mean() == pytest.approx(1 / rate, rel=0.25), arr
+        gaps[arr] = g
+    # ...so the heavy tails must show in the extreme quantile
+    p999 = {a: float(np.percentile(g, 99.9)) for a, g in gaps.items()}
+    assert p999["lognormal"] > p999["poisson"]
+    assert p999["pareto"] > p999["poisson"]
+
+
+def test_uniform_arrivals_are_constant_gap():
+    events = trace.synthesize(10, arrivals="uniform", rate=50.0)
+    gaps = np.diff([e.ts for e in events])
+    assert np.allclose(gaps, 0.02)
+
+
+def test_pareto_requires_finite_mean():
+    with pytest.raises(ValueError, match="alpha > 1"):
+        trace.synthesize(10, arrivals="pareto", alpha=0.9)
+
+
+def test_unknown_arrival_process_rejected():
+    with pytest.raises(ValueError, match="unknown arrival"):
+        trace.synthesize(10, arrivals="bursty")
+
+
+def test_size_mix_proportions_and_shapes():
+    events = trace.synthesize(
+        2000, size_mix=((1, 0.75), (8, 0.25)), shape=(16,), seed=7
+    )
+    rows = np.asarray([e.n_rows for e in events])
+    assert set(rows) == {1, 8}
+    assert (rows == 8).mean() == pytest.approx(0.25, abs=0.05)
+    assert all(e.shape == (16,) for e in events)
+
+
+def test_deadline_distribution():
+    fixed = trace.synthesize(50, deadline_ms=100.0)
+    assert all(e.deadline_ms == 100.0 for e in fixed)
+    jittered = trace.synthesize(
+        500, deadline_ms=100.0, deadline_sigma=0.5, seed=8
+    )
+    ds = np.asarray([e.deadline_ms for e in jittered])
+    assert (ds > 0).all()
+    assert ds.std() > 0
+    assert ds.mean() == pytest.approx(100.0, rel=0.2)
+
+
+def test_synthesize_is_deterministic_per_seed():
+    a = trace.synthesize(100, seed=9, size_mix=((1, 0.5), (4, 0.5)))
+    b = trace.synthesize(100, seed=9, size_mix=((1, 0.5), (4, 0.5)))
+    assert [(e.ts, e.n_rows) for e in a] == [(e.ts, e.n_rows) for e in b]
+
+
+def test_parse_size_mix():
+    assert trace.parse_size_mix("1:0.8,4:0.2") == [(1, 0.8), (4, 0.2)]
+    with pytest.raises(ValueError):
+        trace.parse_size_mix("1")
+
+
+def test_summarize():
+    events = trace.synthesize(
+        100, rate=100.0, size_mix=((1, 0.5), (2, 0.5)),
+        deadline_ms=10.0, seed=1,
+    )
+    doc = trace.summarize(events)
+    assert doc["requests"] == 100
+    assert doc["with_deadline"] == 100
+    assert set(doc["size_counts"]) <= {"1", "2"}
+    assert trace.summarize([]) == {"requests": 0}
