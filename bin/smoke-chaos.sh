@@ -113,16 +113,30 @@ echo "PASS /chaosz arm/disarm round-trip"
 # above (in-process, steadier clock); this two-process drill also
 # fights socket + client-thread scheduling noise on a shared CI
 # host, so its tail bound gets headroom — the hard invariants
-# (nothing lost, typed-only, readiness back) stay exact.
-JAX_PLATFORMS=cpu PYTHONPATH="$ROOT" \
-    python -m keystone_tpu serve-loadgen --target "$BASE" --d "$D" \
-    --synthetic 240 --arrivals poisson --rate 60 \
-    --fault 'gateway.lane.kill=lane:0' --fault-at 1.5 --fault-for 1.5 \
-    --settle-s 4 --recovery-s 10 --p99-factor 2.0 --max-shed-rate 0.8 \
-    --report "$VERDICT" | tee "$LOADGEN_LOG" || {
-    echo "FAIL: serve-loadgen exited red"; cat "$VERDICT" 2>/dev/null; exit 1; }
-grep -q '"passed": true' "$VERDICT" || {
-    echo "FAIL: verdict file not green"; cat "$VERDICT"; exit 1; }
+# (nothing lost, typed-only, readiness back) stay exact — AND one
+# bounded retry: the p99-recovery clock races the host scheduler, so
+# a single red drill on a loaded box gets one fresh chance (the drill
+# is idempotent — it arms its own fault over /chaosz each run and the
+# fired-count audit is delta-based) before the smoke fails for real.
+DRILL_OK=""
+for attempt in 1 2; do
+    if JAX_PLATFORMS=cpu PYTHONPATH="$ROOT" \
+        python -m keystone_tpu serve-loadgen --target "$BASE" --d "$D" \
+        --synthetic 240 --arrivals poisson --rate 60 \
+        --fault 'gateway.lane.kill=lane:0' --fault-at 1.5 --fault-for 1.5 \
+        --settle-s 4 --recovery-s 10 --p99-factor 2.0 --max-shed-rate 0.8 \
+        --report "$VERDICT" | tee "$LOADGEN_LOG" \
+        && grep -q '"passed": true' "$VERDICT"; then
+        DRILL_OK=1
+        break
+    fi
+    echo "drill attempt $attempt not green; $([ "$attempt" -lt 2 ] \
+        && echo 'retrying once (host-load flake guard)' \
+        || echo 'out of retries')"
+    cat "$VERDICT" 2>/dev/null || true
+done
+[[ -n "$DRILL_OK" ]] || {
+    echo "FAIL: serve-loadgen drill red on both attempts"; exit 1; }
 echo "PASS loadgen drill (verdict green: every admitted request" \
      "resolved, typed sheds only, readiness + p99 recovered)"
 

@@ -120,6 +120,11 @@ def main(argv=None) -> int:
               "tail arrivals, arms fault points mid-run via /chaosz, "
               "and exits nonzero unless the serving invariants held; "
               "keystone_tpu/loadgen/)")
+        print("  serve-aot-build  (pre-populate the AOT serialized-"
+              "executable store: compile every bucket once and "
+              "serialize the executables so a brand-new host's "
+              "serve-gateway goes from exec() to serving with zero "
+              "XLA compiles; keystone_tpu/serving/aot.py)")
         print("options:")
         print("  --gateway-port N shorthand for `serve-gateway "
               "--gateway-port N`: admission-")
@@ -170,6 +175,10 @@ def main(argv=None) -> int:
         from keystone_tpu.loadgen.cli import main as serve_loadgen_main
 
         return serve_loadgen_main(argv[1:])
+    if app == "serve-aot-build":
+        from keystone_tpu.serving.aot import build_main
+
+        return build_main(argv[1:])
     if app not in APPS:
         print(f"unknown app {app!r}; run with --help for the list")
         return 2

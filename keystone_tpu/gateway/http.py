@@ -594,10 +594,23 @@ def main(argv=None) -> int:
     ap.add_argument("--d", type=int, default=256)
     ap.add_argument("--hidden", type=int, default=512)
     ap.add_argument("--depth", type=int, default=4)
-    ap.add_argument("--no-cache", action="store_true")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="run with NO persistence: skips both the "
+                    "persistent XLA compile cache and the AOT "
+                    "serialized-executable store, so every bucket "
+                    "pays a real trace + compile")
+    ap.add_argument("--aot-cache", default=None, metavar="DIR",
+                    help="AOT executable store dir (default: "
+                    "$KEYSTONE_AOT_CACHE, then "
+                    "~/.cache/keystone_tpu/aot); pre-populate with "
+                    "serve-aot-build for a zero-compile cold start. "
+                    "Ignored under --no-cache")
     args = ap.parse_args(argv)
     if not args.no_cache:
         setup_compilation_cache()
+        from keystone_tpu.parallel.runtime import setup_aot_cache
+
+        setup_aot_cache(args.aot_cache)
 
     if args.slo_latency_ms is not None:
         # the forensic chain (exemplars, flight records, burn gauges)

@@ -407,7 +407,11 @@ class Gateway:
                     hist, proposal
                 ),
             }
-            self.swap_engines(proposal)
+            if not self.swap_engines(proposal):
+                # close() won the race: nothing rotated, so no audit,
+                # no log line, and the caller (POST /swap) must not be
+                # told a swap happened
+                return False
             self.last_rebucket_audit = audit
             logger.info(
                 "gateway %s rebucket %s -> %s: observed padding "
@@ -420,17 +424,93 @@ class Gateway:
             )
             return True
 
-    def swap_engines(self, buckets: Sequence[int]) -> None:
-        """Build + warm one replacement engine per lane with ``buckets``
-        and atomically swap them in (in-flight windows finish on the old
-        engines; queued and future requests use the new ones)."""
+    def build_engines(self, buckets: Sequence[int]) -> list:
+        """Build + warm one replacement engine per lane with
+        ``buckets`` — the warm-pool half of a swap. Runs outside the
+        POOL's lock (so lanes keep serving and the pool stays
+        closeable while the next generation compiles) but under the
+        gateway's swap lock when driven by ``swap_engines``: engine
+        construction claims the per-lane metrics labels
+        (newest-claim-wins), so two generations building concurrently
+        could rotate in an engine whose label another build claimed —
+        one swap at a time stays the invariant. With the AOT
+        executable store configured (``serving/aot.py``) the
+        "compiles" are deserializes and this returns in milliseconds;
+        either way the engines come back fully warmed and ready to
+        rotate in."""
         buckets = tuple(sorted(set(int(b) for b in buckets)))
+        return self.pool.build_replacements(
+            self._factory_for(buckets),
+            warmup_example=self._warmup_example,
+        )
+
+    def swap_engines(
+        self, buckets: Sequence[int], background: bool = False
+    ):
+        """Rotate the next engine generation in: build + warm one
+        replacement per lane (``build_engines`` — outside the pool
+        lock, from the AOT store when configured) and atomically
+        re-point every lane's batcher (in-flight windows finish on the
+        old engines; queued and future requests use the new ones).
+
+        ``background=True`` is the warm-pool mode: the build AND the
+        rotation run on a background builder thread and the returned
+        ``Future`` resolves True once the rotation happened (False if
+        the gateway closed first; a build/swap failure lands on the
+        future as its exception, with the old engines still serving).
+        Synchronous calls return the same bool directly — False means
+        a close() won the race and NOTHING rotated, which callers like
+        ``rebucket`` must not report as a swap."""
+        buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not background:
+            return self._build_and_swap(buckets)
+        fut: Future = Future()
+
+        def run():
+            try:
+                fut.set_result(self._build_and_swap(buckets))
+            except Exception as e:
+                logger.exception(
+                    "gateway %s: background engine swap to %s failed "
+                    "(old engines keep serving)", self.name, buckets,
+                )
+                fut.set_exception(e)
+
+        threading.Thread(
+            target=run, name=f"keystone-{self.name}-warmpool",
+            daemon=True,
+        ).start()
+        return fut
+
+    def _build_and_swap(self, buckets: tuple) -> bool:
+        if self._closed:
+            # already closed before the build even started: skip the
+            # whole generation build (per-lane compiles + metrics
+            # label re-registration) for a gateway that's gone
+            return False
         with self._swap_lock:
-            self.pool.swap(
-                self._factory_for(buckets),
-                warmup_example=self._warmup_example,
-            )
+            # the BUILD happens under the swap lock too (re-entrant
+            # from rebucket): builds claim the lane metrics labels at
+            # engine construction, so build order must equal rotation
+            # order — what stays unlocked is the POOL, which keeps
+            # serving and closeable throughout
+            engines = self.build_engines(buckets)
+            if self._closed:
+                # a background build that lost the race with close():
+                # the fresh engines are dropped, nothing rotated
+                return False
+            try:
+                self.pool.swap(
+                    self._factory_for(buckets), engines=engines
+                )
+            except RuntimeError:
+                if self._closed:
+                    # close() won the race between our check and the
+                    # pool's own: a normal shutdown, not a swap failure
+                    return False
+                raise
             self._buckets = buckets
+        return True
 
     def _chaos_forced_swap(self, spec) -> None:
         """``gateway.swap.force`` trigger body (injector background

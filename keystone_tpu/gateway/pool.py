@@ -356,6 +356,7 @@ class EnginePool:
         self,
         engine_factory: Optional[EngineFactory] = None,
         warmup_example: Any = None,
+        engines: Optional[Sequence[CompiledPipeline]] = None,
     ) -> List[CompiledPipeline]:
         """Replace every lane's engine atomically-per-lane: build (and
         optionally warm) ALL replacements first — a failure there aborts
@@ -363,19 +364,30 @@ class EnginePool:
         lane's batcher. Returns the displaced engines (callers normally
         drop them; in-flight windows finish on them regardless).
 
+        ``engines``: PREBUILT (and already-warmed) replacements, one
+        per lane in lane order — the Gateway warm-pool path builds the
+        next generation outside this lock (on a background builder
+        thread, from the AOT executable store when configured), so the
+        work under the lock here is just the atomic re-point.
+
         Engines are rebuilt under their lane's original name, so the
         ServingMetrics label-transfer rule keeps one Prometheus series
         per lane across any number of swaps."""
         factory = engine_factory or self._factory
+        if engines is not None and len(engines) != len(self.lanes):
+            raise ValueError(
+                f"need one prebuilt engine per lane "
+                f"({len(self.lanes)}), got {len(engines)}"
+            )
         with self._lock:
             if self._closed:
                 raise RuntimeError("EnginePool is closed")
-            replacements = []
-            for lane in self.lanes:
-                eng = factory(self.lane_name(lane.index))
-                if warmup_example is not None:
-                    eng.warmup(example=warmup_example)
-                replacements.append(eng)
+            if engines is not None:
+                replacements = list(engines)
+            else:
+                replacements = self.build_replacements(
+                    factory, warmup_example=warmup_example
+                )
             old = [
                 lane.batcher.swap_engine(eng)
                 for lane, eng in zip(self.lanes, replacements)
@@ -388,6 +400,25 @@ class EnginePool:
             self.name, len(old), replacements[0].buckets,
         )
         return old
+
+    def build_replacements(
+        self,
+        engine_factory: Optional[EngineFactory] = None,
+        warmup_example: Any = None,
+    ) -> List[CompiledPipeline]:
+        """Build (and optionally warm) one replacement engine per lane
+        under the lanes' names — the ONE generation-build loop, shared
+        by ``swap()``'s build-inline path and the Gateway warm pool
+        (which runs it outside this pool's lock and hands the result
+        back via ``swap(engines=...)``)."""
+        factory = engine_factory or self._factory
+        replacements = []
+        for lane in self.lanes:
+            eng = factory(self.lane_name(lane.index))
+            if warmup_example is not None:
+                eng.warmup(example=warmup_example)
+            replacements.append(eng)
+        return replacements
 
     def warmup(self, example: Any) -> None:
         for lane in self.lanes:

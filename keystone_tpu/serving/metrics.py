@@ -64,7 +64,15 @@ _engine_ids = itertools.count()
 
 
 class ServingMetrics:
-    def __init__(self, latency_window: int = 4096):
+    def __init__(
+        self, latency_window: int = 4096, clock=time.perf_counter
+    ):
+        # every windowed-rate gauge reads this clock; tests inject a
+        # fake to make "a window elapsed" a statement instead of a
+        # sleep (the real-sleep versions divided by tiny lifetimes and
+        # flaked whenever a loaded CI host stretched the gap between
+        # record and read)
+        self._clock = clock
         # bucket -> number of XLA traces (each trace = one compile)
         self.compiles = Counter()
         # bucket -> number of compiled-program dispatches
@@ -126,7 +134,7 @@ class ServingMetrics:
             Tuple[float, int, int, float]
         ] = collections.deque()
         self._lock = threading.Lock()
-        self._t0 = time.perf_counter()
+        self._t0 = self._clock()
 
     # -- engine-side hooks -------------------------------------------------
 
@@ -153,7 +161,7 @@ class ServingMetrics:
             self.device_flops.inc(None, flops)
         if seconds is not None:
             self.dispatch_latency.record(seconds)
-        now = time.perf_counter()
+        now = self._clock()
         with self._lock:
             self._rate_events.append((now, n_valid, padded, flops))
             cutoff = now - RATE_WINDOW_S
@@ -211,7 +219,7 @@ class ServingMetrics:
     def record_window(self) -> None:
         """One pipelined window fully delivered."""
         self.windows.inc(None)
-        now = time.perf_counter()
+        now = self._clock()
         with self._lock:
             self._window_events.append(now)
             cutoff = now - RATE_WINDOW_S
@@ -256,7 +264,7 @@ class ServingMetrics:
         is the gauge ``summary()`` and ``/metrics`` export — unlike the
         lifetime average it goes to zero when traffic stops instead of
         decaying slowly forever."""
-        now = time.perf_counter()
+        now = self._clock()
         window = min(window, RATE_WINDOW_S, max(now - self._t0, 1e-9))
         cutoff = now - window
         with self._lock:
@@ -274,7 +282,7 @@ class ServingMetrics:
         ``autoscale.padding_waste`` estimate — what actually went over
         the wire, not what the histogram model predicts. None with no
         dispatches in the window (absent gauge, not a fake 1.0)."""
-        now = time.perf_counter()
+        now = self._clock()
         window = min(window, RATE_WINDOW_S, max(now - self._t0, 1e-9))
         cutoff = now - window
         with self._lock:
@@ -289,7 +297,7 @@ class ServingMetrics:
     def flops_per_sec(self, window: float = RATE_WINDOW_S) -> float:
         """Windowed modeled device FLOP/s (zero until a dispatched
         bucket has a registered cost model)."""
-        now = time.perf_counter()
+        now = self._clock()
         window = min(window, RATE_WINDOW_S, max(now - self._t0, 1e-9))
         cutoff = now - window
         with self._lock:
@@ -358,7 +366,7 @@ class ServingMetrics:
     def windows_per_sec(self, window: float = RATE_WINDOW_S) -> float:
         """Sustained pipelined-window completion rate (windowed like
         ``examples_per_sec``)."""
-        now = time.perf_counter()
+        now = self._clock()
         window = min(window, RATE_WINDOW_S, max(now - self._t0, 1e-9))
         cutoff = now - window
         with self._lock:
@@ -415,7 +423,7 @@ class ServingMetrics:
         warmup, so it's a capacity sanity number, not an instantaneous
         throughput gauge. Benches that need a true rate time their own
         window (serving/bench.py does)."""
-        dt = time.perf_counter() - self._t0
+        dt = self._clock() - self._t0
         return self.examples.total / dt if dt > 0 else 0.0
 
     def summary(self) -> Dict:

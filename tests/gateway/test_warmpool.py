@@ -1,0 +1,156 @@
+"""Warm engine pool: prebuilt-engine swap (build outside the pool
+lock), background next-generation rotation, and the gateway lifecycle
+riding the AOT executable store end to end."""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.gateway.lifecycle import Gateway
+from keystone_tpu.gateway.metrics import GatewayMetrics
+from keystone_tpu.gateway.pool import EnginePool
+from keystone_tpu.observability.registry import MetricsRegistry
+from keystone_tpu.serving import aot
+from keystone_tpu.serving.aot import AotStore
+
+from gateway_fixtures import D, batch, reference
+
+WARM = jnp.zeros((D,), jnp.float32)
+
+
+def make_pool(fitted, n_lanes=2, buckets=(4,)):
+    return EnginePool(
+        lambda name: fitted.compiled(buckets=buckets, name=name),
+        n_lanes,
+        name="warmpool-test",
+        max_delay_ms=2.0,
+        metrics=GatewayMetrics(
+            registry=MetricsRegistry(), gateway="warmpool-test"
+        ),
+    )
+
+
+def test_pool_swap_accepts_prebuilt_engines(fitted):
+    pool = make_pool(fitted, n_lanes=2)
+    with pool:
+        prebuilt = [
+            fitted.compiled(buckets=(8,), name=pool.lane_name(i))
+            for i in range(2)
+        ]
+        for eng in prebuilt:
+            eng.warmup(example=WARM)
+        old = pool.swap(engines=prebuilt)
+        assert len(old) == 2
+        assert [l.engine for l in pool.lanes] == prebuilt
+        xs = batch(6, seed=7)
+        futs = [pool.submit(x) for x in xs]
+        rows = np.stack([np.asarray(f.result(timeout=30)) for f in futs])
+    np.testing.assert_allclose(
+        rows, reference(fitted, xs), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_pool_swap_rejects_wrong_prebuilt_count(fitted):
+    pool = make_pool(fitted, n_lanes=2)
+    with pool:
+        lonely = fitted.compiled(buckets=(8,), name="only-one")
+        with pytest.raises(ValueError, match="one prebuilt engine"):
+            pool.swap(engines=[lonely])
+        # the failed swap left the original engines serving
+        assert pool.submit(batch(1)[0]).result(timeout=30) is not None
+
+
+def test_background_swap_rotates_under_traffic(fitted):
+    with Gateway(
+        fitted, buckets=(4,), n_lanes=2, max_delay_ms=2.0,
+        warmup_example=WARM, name="bg-swap",
+        registry=MetricsRegistry(),
+    ) as gw:
+        stop = threading.Event()
+        failures = []
+
+        def client():
+            while not stop.is_set():
+                try:
+                    gw.predict(batch(1, seed=3)[0]).result(timeout=30)
+                except Exception as e:  # pragma: no cover - fail loud
+                    failures.append(e)
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        fut = gw.swap_engines((4, 8), background=True)
+        assert fut.result(timeout=60) is True
+        stop.set()
+        t.join(timeout=30)
+        assert gw.buckets == (4, 8)
+        assert not failures, f"requests failed across the swap: {failures}"
+        # traffic still resolves on the rotated engines
+        out = gw.predict(batch(1, seed=4)[0]).result(timeout=30)
+        assert np.asarray(out).shape == (3,)
+
+
+def test_background_swap_after_close_is_a_noop(fitted):
+    gw = Gateway(
+        fitted, buckets=(4,), n_lanes=1, max_delay_ms=2.0,
+        warmup_example=WARM, name="bg-closed",
+        registry=MetricsRegistry(),
+    )
+    gw.close()
+    fut = gw.swap_engines((4, 8), background=True)
+    assert fut.result(timeout=60) is False  # dropped, nothing rotated
+
+
+def test_gateway_lanes_and_next_generation_ride_the_aot_store(
+    fitted, tmp_path, monkeypatch
+):
+    """The zero-cold-start lifecycle: with the store configured, every
+    lane engine (and every next-generation engine a swap builds) warms
+    from serialized executables — zero traces after the store is
+    populated."""
+    from keystone_tpu.parallel import runtime
+
+    monkeypatch.setattr(runtime, "_aot_dir", None)
+    monkeypatch.setattr(aot, "_configured", None)
+    root = str(tmp_path / "aot")
+    assert runtime.setup_aot_cache(root) == root
+    store = aot.configured_store()
+
+    # generation 0 populates (misses + saves); lane 1 already hits the
+    # entries lane 0 saved moments earlier
+    with Gateway(
+        fitted, buckets=(4,), n_lanes=2, max_delay_ms=2.0,
+        warmup_example=WARM, name="aot-gw-0",
+        registry=MetricsRegistry(),
+    ):
+        pass
+    saves0, hits0 = store.saves, store.hits
+    assert saves0 >= 1
+
+    # a brand-new "process" (fresh gateway, same store): every lane hits
+    with Gateway(
+        fitted, buckets=(4,), n_lanes=2, max_delay_ms=2.0,
+        warmup_example=WARM, name="aot-gw-1",
+        registry=MetricsRegistry(),
+    ) as gw:
+        assert store.hits >= hits0 + 2
+        for lane in gw.pool.lanes:
+            assert lane.engine.aot_report()[4]["status"] == "hit"
+            assert lane.engine.metrics.compile_count == 0
+        hits1 = store.hits
+        # the warm pool: a same-bucket background rotation deserializes
+        # the next generation instead of compiling it
+        t0 = time.perf_counter()
+        fut = gw.swap_engines((4,), background=True)
+        assert fut.result(timeout=60) is True
+        swap_s = time.perf_counter() - t0
+        assert store.hits >= hits1 + 2
+        for lane in gw.pool.lanes:
+            assert lane.engine.metrics.compile_count == 0
+        out = gw.predict(batch(1, seed=9)[0]).result(timeout=30)
+        assert np.asarray(out).shape == (3,)
+        # not a strict perf assert, just a sanity ceiling: a
+        # deserialize-based rotation must not take compile-scale time
+        assert swap_s < 30

@@ -2,7 +2,6 @@
 bridge lifecycle."""
 
 import gc
-import time
 
 import pytest
 
@@ -29,14 +28,31 @@ def test_latency_recorder_p95_and_snapshot():
     }
 
 
+class FakeClock:
+    """Injectable ``ServingMetrics`` clock: elapsed time becomes a
+    statement (``advance``), not a ``time.sleep`` that a loaded CI
+    host can stretch — the windowed-rate tests below used to divide
+    by real tiny lifetimes and flake under full-suite load."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
 def test_windowed_rate_decays_to_zero_but_lifetime_does_not_jump():
-    m = ServingMetrics()
+    clk = FakeClock()
+    m = ServingMetrics(clock=clk)
+    clk.advance(1.0)
     m.record_dispatch(bucket=8, n_valid=8, seconds=0.001)
-    # fresh traffic: windowed rate sees all 8 examples over a tiny
-    # lifetime (clamped window), so it's large and positive
+    # fresh traffic: the windowed rate sees all 8 examples
     assert m.examples_per_sec() > 0
     # a very small window that has already passed: rate decays to zero
-    time.sleep(0.05)
+    clk.advance(0.05)
     assert m.examples_per_sec(window=0.01) == 0.0
     # the lifetime average still counts them (the documented wart the
     # windowed gauge exists to fix: lifetime dilutes over idle time,
@@ -89,17 +105,30 @@ def test_windowed_rate_clamps_oversized_window():
     """Events older than RATE_WINDOW_S are pruned at record time, so a
     window larger than that must clamp instead of silently dividing a
     30s sum by more seconds (4x undercount otherwise)."""
-    m = ServingMetrics()
+    clk = FakeClock()
+    m = ServingMetrics(clock=clk)
     m.record_dispatch(bucket=8, n_valid=8, seconds=0.001)
-    # let some lifetime accrue so the microseconds between the two
-    # reads below are noise, not a 2x swing in the divisor (this test
-    # used to flake under full-suite load on a young instance)
-    time.sleep(0.05)
+    clk.advance(0.05)
     lifetime = m.examples_per_sec()  # window = lifetime here (young)
-    assert m.examples_per_sec(window=1e6) == pytest.approx(
-        lifetime, rel=0.5
-    )
+    # the fake clock holds still between the reads, so the clamp is
+    # EXACT (the real-clock version needed a 50 ms sleep and a wide
+    # tolerance, and still flaked under host load)
+    assert m.examples_per_sec(window=1e6) == pytest.approx(lifetime)
     assert m.examples_per_sec(window=1e6) > 0
+
+
+def test_rate_events_prune_past_the_window():
+    from keystone_tpu.serving.metrics import RATE_WINDOW_S
+
+    clk = FakeClock()
+    m = ServingMetrics(clock=clk)
+    m.record_dispatch(bucket=8, n_valid=8)
+    # a full rate window plus slack later, a new dispatch prunes the
+    # old event: only the fresh 2 examples remain countable
+    clk.advance(RATE_WINDOW_S + 1.0)
+    m.record_dispatch(bucket=8, n_valid=2)
+    assert m.examples_per_sec() == pytest.approx(2 / RATE_WINDOW_S)
+    assert len(m._rate_events) == 1
 
 
 def test_same_label_reregistration_transfers_ownership():
@@ -238,11 +267,13 @@ def test_empty_cost_model_is_dropped():
 
 
 def test_padding_efficiency_none_before_traffic_and_windowed():
-    m = ServingMetrics()
+    clk = FakeClock()
+    m = ServingMetrics(clock=clk)
     assert m.padding_efficiency() is None
+    clk.advance(1.0)
     m.record_dispatch(bucket=8, n_valid=8)
     assert m.padding_efficiency() == pytest.approx(1.0)
-    time.sleep(0.05)
+    clk.advance(0.05)
     # outside the window: gauge decays to absent, not a stale 1.0
     assert m.padding_efficiency(window=0.01) is None
 
