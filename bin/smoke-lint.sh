@@ -1,0 +1,108 @@
+#!/usr/bin/env bash
+# Smoke-test the keystone-lint CI gate:
+#
+#   1. `keystone-lint --json` over the repo must exit 0 (every finding
+#      fixed, suppressed-with-justification, or baselined) and emit a
+#      JSON document that parses against the expected schema;
+#   2. the human renderer agrees with the JSON verdict;
+#   3. `--changed-only` (the fast local loop over `git diff
+#      --name-only`) runs and exits 0 on a clean tree;
+#   4. the analyzer still has teeth: a scratch file with a known
+#      violation of each quick rule must fail with exit 1 and name the
+#      rules — a gate that can't fail isn't a gate.
+#
+# CI-friendly: stdlib-only analyzer (no jax import), < 10 s.
+#
+#   bin/smoke-lint.sh
+set -euo pipefail
+
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+TMPDIR="$(mktemp -d)"
+cleanup() { rm -rf "$TMPDIR"; }
+trap cleanup EXIT
+
+cd "$ROOT"
+
+# ---- 1. clean JSON run ---------------------------------------------------
+echo "== keystone-lint --json (the CI gate) =="
+python -m keystone_tpu keystone-lint --json > "$TMPDIR/lint.json"
+python - "$TMPDIR/lint.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+for key in ("version", "root", "clean", "counts", "findings", "rules"):
+    if key not in doc:
+        raise SystemExit(f"FAIL: JSON output missing key {key!r}")
+if doc["version"] != 1:
+    raise SystemExit(f"FAIL: unexpected schema version {doc['version']}")
+if not doc["clean"]:
+    raise SystemExit(f"FAIL: repo not lint-clean: {doc['findings']}")
+if len(doc["rules"]) != 6:
+    raise SystemExit(f"FAIL: expected 6 rules, got {doc['rules']}")
+counts = doc["counts"]
+for key in ("findings", "baselined", "suppressed", "stale_baseline"):
+    if key not in counts:
+        raise SystemExit(f"FAIL: counts missing {key!r}")
+print(f"PASS schema + clean (suppressed={counts['suppressed']}, "
+      f"baselined={counts['baselined']})")
+EOF
+
+# ---- 2. human renderer agrees --------------------------------------------
+python -m keystone_tpu keystone-lint | tail -1 | grep -q '0 finding(s)' || {
+    echo "FAIL: human output disagrees with the JSON verdict"; exit 1; }
+echo "PASS human renderer"
+
+# ---- 3. --changed-only fast path -----------------------------------------
+echo "== keystone-lint --changed-only =="
+python -m keystone_tpu keystone-lint --changed-only --json \
+    > "$TMPDIR/changed.json"
+python - "$TMPDIR/changed.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+if not doc.get("changed_only"):
+    raise SystemExit("FAIL: changed_only not marked in output")
+if not doc["clean"]:
+    raise SystemExit(f"FAIL: changed-only run dirty: {doc['findings']}")
+print("PASS --changed-only")
+EOF
+
+# ---- 4. the gate can fail ------------------------------------------------
+echo "== seeded violations must fail =="
+FIXTURE_ROOT="$TMPDIR/proj"
+mkdir -p "$FIXTURE_ROOT/pkg"
+cat > "$FIXTURE_ROOT/pkg/bad.py" <<'EOF'
+import threading
+import time
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}  # guarded-by: _lock
+
+    def bad_write(self):
+        self._state = {}
+
+    def bad_block(self):
+        with self._lock:
+            time.sleep(1.0)
+
+
+def gate(ok):
+    assert ok, "stripped under -O"
+EOF
+set +e
+python -m keystone_tpu keystone-lint --root "$FIXTURE_ROOT" \
+    --baseline absent.json pkg > "$TMPDIR/bad.out" 2>&1
+RC=$?
+set -e
+[[ "$RC" -eq 1 ]] || {
+    echo "FAIL: seeded violations exited $RC (want 1)"
+    cat "$TMPDIR/bad.out"; exit 1; }
+for rule in guarded-by blocking-under-lock strippable-assert; do
+    grep -q "$rule" "$TMPDIR/bad.out" || {
+        echo "FAIL: seeded $rule violation not reported"
+        cat "$TMPDIR/bad.out"; exit 1; }
+done
+echo "PASS seeded violations fail with exit 1"
+
+echo "smoke-lint: all checks passed"

@@ -125,6 +125,12 @@ def main(argv=None) -> int:
               "serialize the executables so a brand-new host's "
               "serve-gateway goes from exec() to serving with zero "
               "XLA compiles; keystone_tpu/serving/aot.py)")
+        print("  keystone-lint  (AST contract analyzer over this "
+              "repo's own source: lock discipline, blocking-under-"
+              "lock, strippable asserts, absent-not-zero metrics, "
+              "hot-path host syncs, fault-point catalog drift; "
+              "nonzero exit on unbaselined findings — the CI gate; "
+              "keystone_tpu/analysis/)")
         print("options:")
         print("  --gateway-port N shorthand for `serve-gateway "
               "--gateway-port N`: admission-")
@@ -179,6 +185,12 @@ def main(argv=None) -> int:
         from keystone_tpu.serving.aot import build_main
 
         return build_main(argv[1:])
+    if app == "keystone-lint":
+        # stdlib-only path by design: the linter must run in hooks and
+        # CI without paying the jax import (analysis/ never imports it)
+        from keystone_tpu.analysis.cli import main as lint_main
+
+        return lint_main(argv[1:])
     if app not in APPS:
         print(f"unknown app {app!r}; run with --help for the list")
         return 2

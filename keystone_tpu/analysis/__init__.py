@@ -1,0 +1,43 @@
+"""keystone-lint: AST-driven contract analysis for this repo's own
+concurrency and hot-path invariants.
+
+KeystoneML's core idea — a rule engine that mechanically checks and
+rewrites pipeline DAGs — pointed at our own source: every rule here is
+a defect class a human review actually caught in PRs 1–8 (lock
+discipline around the tracer ring / staging-bytes gauge / request-log
+close, blocking work under the pool lock, ``-O``-strippable asserts in
+enforcement paths, zeros stamped on degradable metric series, host
+syncs on the serving hot path, fault-point catalog drift), turned into
+a checked invariant so refactors keep them for free.
+
+Stdlib-only by design (``ast`` + ``tokenize`` comments): the linter
+must run in CI images and pre-commit hooks without paying the jax
+import, so nothing in this package may import jax or any keystone
+module that does.
+
+Entry points: ``python -m keystone_tpu keystone-lint`` (cli.py),
+``bin/smoke-lint.sh`` (CI), and ``tests/analysis/test_self_clean.py``
+(the tier-1 gate — the analyzer runs over ``keystone_tpu/`` inside the
+normal test suite and fails on any unbaselined finding).
+"""
+
+from keystone_tpu.analysis.core import (
+    Baseline,
+    FileContext,
+    Finding,
+    Project,
+    Rule,
+    run_analysis,
+)
+from keystone_tpu.analysis.rules import ALL_RULES, default_rules
+
+__all__ = [
+    "ALL_RULES",
+    "Baseline",
+    "FileContext",
+    "Finding",
+    "Project",
+    "Rule",
+    "default_rules",
+    "run_analysis",
+]

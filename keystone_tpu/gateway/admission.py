@@ -138,10 +138,12 @@ class AdmissionController:
         self.metrics = metrics if metrics is not None else GatewayMetrics(
             gateway=name
         )
-        self._queue: Deque[_Request] = collections.deque()
+        self._queue: Deque[_Request] = collections.deque()  # guarded-by: _cond
         self._cond = threading.Condition()
-        self._accepting = True
-        self._completions: Deque[float] = collections.deque(maxlen=2048)
+        self._accepting = True  # guarded-by: _cond
+        self._completions: Deque[float] = (
+            collections.deque(maxlen=2048)
+        )  # guarded-by: _comp_lock
         self._comp_lock = threading.Lock()
         pool.add_free_listener(self._wake)
         self._router = threading.Thread(

@@ -483,7 +483,10 @@ class GatewayServer(BackgroundServer, device_obs.MemorySamplerHost):
         self.input_dtype = np.dtype(input_dtype)
         self.request_log = bool(request_log)
         self.chaos_routes = bool(chaos_routes)
-        self._request_log_file = None
+        # the stop() close race (PR 7 review): a straggler handler
+        # thread must re-check this under the lock, never write to a
+        # closed file — the guarded-by rule keeps it that way
+        self._request_log_file = None  # guarded-by: _request_log_lock
         self._request_log_lock = threading.Lock()
         self._log_to_file = isinstance(request_log, (str, bytes)) or hasattr(
             request_log, "__fspath__"

@@ -493,8 +493,11 @@ class Gateway:
             # from rebucket): builds claim the lane metrics labels at
             # engine construction, so build order must equal rotation
             # order — what stays unlocked is the POOL, which keeps
-            # serving and closeable throughout
-            engines = self.build_engines(buckets)
+            # serving and closeable throughout. That makes this a
+            # deliberate blocking-under-lock exception: _swap_lock is
+            # the coarse one-swap-at-a-time maintenance lock, held by
+            # nothing on the request plane.
+            engines = self.build_engines(buckets)  # lint: disable=blocking-under-lock
             if self._closed:
                 # a background build that lost the race with close():
                 # the fresh engines are dropped, nothing rotated

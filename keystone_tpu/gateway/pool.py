@@ -80,9 +80,9 @@ class Lane:
         )
         self._capacity_pinned = int(capacity) if capacity else None
         self._lock = threading.Lock()
-        self._inflight = 0
-        self._consecutive_failures = 0
-        self._last_failure_t = 0.0
+        self._inflight = 0  # guarded-by: _lock
+        self._consecutive_failures = 0  # guarded-by: _lock
+        self._last_failure_t = 0.0  # guarded-by: _lock
 
     @property
     def capacity(self) -> int:
@@ -187,11 +187,11 @@ class EnginePool:
         self.max_retries = max_retries
         self.name = name
         self.metrics = metrics
-        self._factory = engine_factory
+        self._factory = engine_factory  # guarded-by: _lock
         self._max_delay_ms = max_delay_ms
         self._lane_capacity = lane_capacity
         self._lock = threading.Lock()
-        self._closed = False
+        self._closed = False  # guarded-by: _lock
         self._free_listeners: List[Callable[[], None]] = []
         self.lanes: List[Lane] = [
             Lane(
@@ -379,15 +379,26 @@ class EnginePool:
                 f"need one prebuilt engine per lane "
                 f"({len(self.lanes)}), got {len(engines)}"
             )
+        if self._closed:
+            raise RuntimeError("EnginePool is closed")
+        if engines is not None:
+            replacements = list(engines)
+        else:
+            # build + warm OUTSIDE the pool lock: the generation build
+            # is seconds of XLA compile (milliseconds off the AOT
+            # store), and holding the lock for it would stall close()
+            # and every other lifecycle call behind one swap. The lock
+            # below covers only the atomic re-point — the same
+            # work-split the Gateway warm pool uses. Swap-vs-swap
+            # serialization is the caller's job (the Gateway holds its
+            # _swap_lock); racing bare-pool swaps would build two
+            # generations and rotate them in arrival order.
+            replacements = self.build_replacements(
+                factory, warmup_example=warmup_example
+            )
         with self._lock:
             if self._closed:
                 raise RuntimeError("EnginePool is closed")
-            if engines is not None:
-                replacements = list(engines)
-            else:
-                replacements = self.build_replacements(
-                    factory, warmup_example=warmup_example
-                )
             old = [
                 lane.batcher.swap_engine(eng)
                 for lane, eng in zip(self.lanes, replacements)

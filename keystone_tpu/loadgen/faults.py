@@ -159,15 +159,17 @@ class FaultInjector:
 
     def __init__(self, registry=None):
         self._lock = threading.Lock()
-        self._specs: Dict[str, FaultSpec] = {}
+        self._specs: Dict[str, FaultSpec] = {}  # guarded-by: _lock
         # point -> [(fn, ctx)]: components register trigger callbacks
         # (e.g. the gateway's forced-swap); arming the point invokes
         # them on a background thread
-        self._triggers: Dict[str, List] = {}
+        self._triggers: Dict[str, List] = {}  # guarded-by: _lock
         # total fires per point, kept across disarms (the /chaosz
         # "fired" audit; the Prometheus counter is the scrape surface)
-        self._fired: Dict[str, int] = {}
-        self.armed = False  # the hot-path gate
+        self._fired: Dict[str, int] = {}  # guarded-by: _lock
+        # the hot-path gate: READ unlocked by design (one attribute
+        # load per call site); every WRITE goes through _lock
+        self.armed = False  # guarded-by: _lock
         self._registry = registry
         self._counter = None  # lazy: first arm touches the registry
 

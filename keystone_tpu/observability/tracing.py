@@ -129,17 +129,24 @@ class Tracer:
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY, enabled: bool = True):
         self.enabled = enabled
-        self._ring: Deque[Span] = collections.deque(maxlen=capacity)
+        # the ring swap incident (PR 4 review): enable_tracing used to
+        # rebuild this deque unguarded and raced concurrent end_span
+        # appenders — exactly what the guarded-by rule now checks
+        self._ring: Deque[Span] = (
+            collections.deque(maxlen=capacity)
+        )  # guarded-by: _lock
         self._lock = threading.Lock()
         self._local = threading.local()
         # span_id -> trace_id for recently started spans, so a child
         # pinned to a cross-thread parent_id joins the parent's trace
         # even after the parent finished; bounded FIFO
-        self._trace_map: Dict[int, str] = {}
-        self._trace_order: Deque[int] = collections.deque()
+        self._trace_map: Dict[int, str] = {}  # guarded-by: _lock
+        self._trace_order: Deque[int] = (
+            collections.deque()
+        )  # guarded-by: _lock
         # sinks observe every FINISHED span (the OTLP exporter installs
         # here); empty list = zero per-span overhead beyond the check
-        self._sinks: List[Callable[[Span], None]] = []
+        self._sinks: List[Callable[[Span], None]] = []  # guarded-by: _lock
 
     # -- span lifecycle ----------------------------------------------------
 

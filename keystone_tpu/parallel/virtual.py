@@ -84,6 +84,8 @@ def provision_devices(n_devices: int, *, probe_real: bool = True) -> None:
         if flag not in flags:
             os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
     have = len(jax.devices())
-    assert have >= n_devices, (
-        f"could not provision {n_devices} virtual CPU devices; got {have}"
-    )
+    if have < n_devices:
+        raise RuntimeError(
+            f"could not provision {n_devices} virtual CPU devices; "
+            f"got {have}"
+        )

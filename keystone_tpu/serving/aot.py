@@ -303,10 +303,10 @@ class AotStore:
         self._sweep_stale_tmp()
         # plain per-store totals for status()/tests, plus the shared
         # scrape families on the (global) MetricsRegistry
-        self.hits = 0
-        self.misses = 0
-        self.errors = 0
-        self.saves = 0
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
+        self.errors = 0  # guarded-by: _lock
+        self.saves = 0  # guarded-by: _lock
         self._lock = threading.Lock()
         from keystone_tpu.observability.registry import (
             get_global_registry,

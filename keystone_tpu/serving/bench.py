@@ -133,16 +133,21 @@ def bench_cold_vs_warm(
     finally:
         if cache_dir is not None:
             jax.config.update("jax_compilation_cache_dir", cache_dir)
-    assert engine.metrics.compile_count == 1, engine.metrics.summary()
+    if engine.metrics.compile_count != 1:
+        raise RuntimeError(
+            "cold apply expected exactly one compile: "
+            + str(engine.metrics.summary())
+        )
 
     warm = []
     for _ in range(warm_reps):
         t0 = time.perf_counter()
         engine.apply(x, sync=True)
         warm.append((time.perf_counter() - t0) * 1e3)
-    assert engine.metrics.compile_count == 1, (
-        "warm dispatches retraced: " + str(engine.metrics.summary())
-    )
+    if engine.metrics.compile_count != 1:
+        raise RuntimeError(
+            "warm dispatches retraced: " + str(engine.metrics.summary())
+        )
     warm_p50 = float(np.percentile(warm, 50))
     speedup = cold_ms / warm_p50
     emit(
@@ -185,7 +190,8 @@ def bench_bucketed_throughput(
             served += n
     dt = time.perf_counter() - t0
     summary = engine.metrics.summary()
-    assert engine.metrics.compile_count <= len(engine.buckets), summary
+    if engine.metrics.compile_count > len(engine.buckets):
+        raise RuntimeError(f"recompile bound broken: {summary}")
     emit(
         "serving_bucketed_throughput", served / dt, "examples/sec",
         extra={
@@ -398,9 +404,10 @@ def bench_swap_blip(
         swap_s[0] = time.perf_counter() - t0
         for t in threads:
             t.join()
-        assert failures[0] == 0, (
-            f"{failures[0]} requests failed across the live swap"
-        )
+        if failures[0] != 0:
+            raise RuntimeError(
+                f"{failures[0]} requests failed across the live swap"
+            )
         emit(
             "serving_swap_blip",
             float(np.percentile(latencies, 99)) * 1e3, "ms",
@@ -494,9 +501,10 @@ def bench_pipeline_overlap(
     piped_engine, piped_dt, piped_rows = drive(pipeline_depth)
 
     for i, (a, b) in enumerate(zip(serial_rows, piped_rows)):
-        assert np.array_equal(a, b), (
-            f"row {i}: pipelined output differs from serial"
-        )
+        if not np.array_equal(a, b):
+            raise RuntimeError(
+                f"row {i}: pipelined output differs from serial"
+            )
 
     m = piped_engine.metrics
     stage_rates = m.stage_rates()
@@ -506,17 +514,18 @@ def bench_pipeline_overlap(
     efficiency = sustained / stage_rates[bottleneck]
     speedup = sustained / serial_rate
     cores = os.cpu_count() or 1
-    assert efficiency > 0.8, (
-        f"pipelined lane sustains {sustained:.1f} windows/s but the "
-        f"bottleneck stage ({bottleneck}) alone does "
-        f"{stage_rates[bottleneck]:.1f} — overlap is broken "
-        f"(efficiency {efficiency:.2f} <= 0.8; stages: "
-        + ", ".join(
-            f"{s} {r:.1f}/s" for s, r in sorted(stage_rates.items())
-        ) + ")"
-    )
-    if cores >= 2:
-        assert speedup >= 1.2, (
+    if efficiency <= 0.8:
+        raise RuntimeError(
+            f"pipelined lane sustains {sustained:.1f} windows/s but "
+            f"the bottleneck stage ({bottleneck}) alone does "
+            f"{stage_rates[bottleneck]:.1f} — overlap is broken "
+            f"(efficiency {efficiency:.2f} <= 0.8; stages: "
+            + ", ".join(
+                f"{s} {r:.1f}/s" for s, r in sorted(stage_rates.items())
+            ) + ")"
+        )
+    if cores >= 2 and speedup < 1.2:
+        raise RuntimeError(
             f"pipelined lane is only {speedup:.2f}x the serial batcher "
             f"({sustained:.1f} vs {serial_rate:.1f} windows/s) on a "
             f"{cores}-core host — stage overlap buys nothing"
@@ -576,13 +585,16 @@ def bench_goodput_mfu(
     predicted = predicted_efficiency(
         m.request_sizes.snapshot(), engine.buckets
     )
-    assert measured is not None, "no dispatches recorded"
-    assert predicted is not None, "no request-size histogram"
-    assert measured >= predicted - 0.02, (
-        f"measured padding efficiency {measured:.4f} fell below the "
-        f"padding_waste-model prediction {predicted:.4f} — the live "
-        f"goodput counters and the offline model disagree"
-    )
+    if measured is None:
+        raise RuntimeError("no dispatches recorded")
+    if predicted is None:
+        raise RuntimeError("no request-size histogram")
+    if measured < predicted - 0.02:
+        raise RuntimeError(
+            f"measured padding efficiency {measured:.4f} fell below "
+            f"the padding_waste-model prediction {predicted:.4f} — the "
+            f"live goodput counters and the offline model disagree"
+        )
     mfu = m.mfu()
     cost_model_buckets = sorted(m.cost_models)
     emit(

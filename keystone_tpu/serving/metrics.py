@@ -95,8 +95,8 @@ class ServingMetrics:
         self._peak_membw: Optional[float] = None
         self._n_devices: int = 1
         # live host staging-buffer bytes (HostBufferPool); None until a
-        # pipelined lane runs
-        self._staging_bytes: Optional[int] = None
+        # pipelined lane runs — the PR 6 stale-gauge incident class
+        self._staging_bytes: Optional[int] = None  # guarded-by: _lock
         # valid-row count of each dispatch (the observed request-size
         # histogram serving/autoscale.py proposes bucket sets from)
         self.request_sizes = Counter()
@@ -117,22 +117,24 @@ class ServingMetrics:
             s: LatencyRecorder(latency_window) for s in PIPELINE_STAGES
         }
         self.windows = Counter()
-        self._stage_queue_depth: Dict[str, int] = {}
+        self._stage_queue_depth: Dict[str, int] = {}  # guarded-by: _lock
         # (timestamp,) per completed pipeline window, pruned like
         # _rate_events: the sustained-window-rate input of the
         # overlap-efficiency gauge
-        self._window_events: Deque[float] = collections.deque()
+        self._window_events: Deque[float] = (
+            collections.deque()
+        )  # guarded-by: _lock
         # enqueue-to-future-resolution time of micro-batched requests
         self.request_latency = LatencyRecorder(latency_window)
-        self._queue_depth = 0
-        self._coalesced_max = 0
+        self._queue_depth = 0  # guarded-by: _lock
+        self._coalesced_max = 0  # guarded-by: _lock
         # (timestamp, valid, padded, modeled flops) per dispatch,
         # pruned to the rate window: the windowed examples/sec,
         # padding-efficiency, and MFU gauges all read this, so idle
         # periods decay to zero instead of diluting a lifetime average
         self._rate_events: Deque[
             Tuple[float, int, int, float]
-        ] = collections.deque()
+        ] = collections.deque()  # guarded-by: _lock
         self._lock = threading.Lock()
         self._t0 = self._clock()
 

@@ -91,17 +91,17 @@ class HostBufferPool:
 
     def __init__(self, max_per_key: int = DEFAULT_DEPTH + 1):
         self.max_per_key = max_per_key
-        self.generation = 0
-        self.allocations = 0
-        self._free: Dict[Any, List[Any]] = {}
+        self.generation = 0  # guarded-by: _lock
+        self.allocations = 0  # guarded-by: _lock
+        self._free: Dict[Any, List[Any]] = {}  # guarded-by: _lock
         # live staging footprint: bytes sitting free in the pool +
         # bytes riding in-flight windows (the
         # ``keystone_serving_staging_bytes`` gauge input)
-        self._pooled_bytes = 0
-        self._outstanding_bytes = 0
+        self._pooled_bytes = 0  # guarded-by: _lock
+        self._outstanding_bytes = 0  # guarded-by: _lock
         # a key pins (bucket, treedef, shapes, dtypes), so its buffer
         # size is a constant — computed once per key, not per window
-        self._key_bytes: Dict[Any, int] = {}
+        self._key_bytes: Dict[Any, int] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     @staticmethod
@@ -111,8 +111,10 @@ class HostBufferPool:
             for a in jax.tree_util.tree_leaves(buffers)
         )
 
-    def _bytes_for(self, key: Any, buffers: Any) -> int:
-        """Cached per-key buffer size (caller holds ``self._lock``)."""
+    def _bytes_for_locked(self, key: Any, buffers: Any) -> int:
+        """Cached per-key buffer size (the ``_locked`` suffix is the
+        caller-holds-``self._lock`` convention the guarded-by lint
+        rule recognizes)."""
         nbytes = self._key_bytes.get(key)
         if nbytes is None:
             nbytes = self._key_bytes[key] = self._tree_bytes(buffers)
@@ -144,7 +146,7 @@ class HostBufferPool:
             free = self._free.get(key)
             if free:
                 buffers = free.pop()
-                nbytes = self._bytes_for(key, buffers)
+                nbytes = self._bytes_for_locked(key, buffers)
                 self._pooled_bytes -= nbytes
                 self._outstanding_bytes += nbytes
                 return self.generation, buffers
@@ -153,7 +155,9 @@ class HostBufferPool:
         buffers = alloc()
         with self._lock:
             if gen == self.generation:
-                self._outstanding_bytes += self._bytes_for(key, buffers)
+                self._outstanding_bytes += self._bytes_for_locked(
+                    key, buffers
+                )
         return gen, buffers
 
     def publish_staging_bytes(self, resolve_metrics: Callable[[], Any]) -> None:
@@ -176,7 +180,7 @@ class HostBufferPool:
                 # cut for a retired engine's buckets: drop (reset()
                 # already zeroed their outstanding-byte accounting)
                 return
-            nbytes = self._bytes_for(key, buffers)
+            nbytes = self._bytes_for_locked(key, buffers)
             self._outstanding_bytes -= nbytes
             free = self._free.setdefault(key, [])
             if len(free) < self.max_per_key:

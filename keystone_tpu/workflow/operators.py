@@ -64,7 +64,10 @@ class DatasetOperator(Operator):
         return ("dataset", id(self.dataset))
 
     def execute(self, deps: Sequence[Expression]) -> Expression:
-        assert not deps
+        if deps:
+            raise AssertionError(
+                f"DatasetOperator takes no dependencies, got {len(deps)}"
+            )
         return DatasetExpression.of(self.dataset)
 
 
@@ -79,7 +82,10 @@ class DatumOperator(Operator):
         return ("datum", id(self.datum))
 
     def execute(self, deps: Sequence[Expression]) -> Expression:
-        assert not deps
+        if deps:
+            raise AssertionError(
+                f"DatumOperator takes no dependencies, got {len(deps)}"
+            )
         return DatumExpression.of(self.datum)
 
 
@@ -126,7 +132,10 @@ class DelegatingOperator(Operator):
     def execute(self, deps: Sequence[Expression]) -> Expression:
         transformer_expr = deps[0]
         data_deps = deps[1:]
-        assert data_deps, "delegating operator needs data dependencies"
+        if not data_deps:
+            raise AssertionError(
+                "delegating operator needs data dependencies"
+            )
         if any(isinstance(d, DatasetExpression) for d in data_deps):
             return DatasetExpression(
                 lambda: transformer_expr.get().batch_transform(
@@ -149,5 +158,8 @@ class ExpressionOperator(Operator):
         self.expression = expression
 
     def execute(self, deps: Sequence[Expression]) -> Expression:
-        assert not deps
+        if deps:
+            raise AssertionError(
+                f"ExpressionOperator takes no dependencies, got {len(deps)}"
+            )
         return self.expression

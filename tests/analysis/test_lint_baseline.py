@@ -1,0 +1,231 @@
+"""Suppression + baseline mechanics: the two escape hatches must work
+(inline `# lint: disable=`, checked-in baseline), round-trip through
+files, and go STALE the moment the offending line changes — the
+baseline only ever shrinks."""
+
+import json
+import textwrap
+
+from keystone_tpu.analysis.core import (
+    Baseline,
+    FileContext,
+    run_analysis,
+)
+from keystone_tpu.analysis.rules import (
+    StrippableAssertRule,
+    default_rules,
+)
+
+BAD = "def gate(ok):\n    assert ok\n"
+
+
+def write_pkg(tmp_path, source=BAD):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "mod.py").write_text(source)
+    return pkg
+
+
+# -- inline suppressions ----------------------------------------------------
+
+
+def test_trailing_suppression_silences_one_line():
+    ctx = FileContext(
+        "m.py", "pkg/m.py",
+        "def gate(ok):\n"
+        "    assert ok  # lint: disable=strippable-assert\n"
+        "    assert ok\n",
+    )
+    fs = list(StrippableAssertRule().check_file(ctx))
+    # both raw findings exist; the runner applies suppression
+    assert len(fs) == 2
+    assert ctx.suppressed("strippable-assert", 2)
+    assert not ctx.suppressed("strippable-assert", 3)
+
+
+def test_standalone_suppression_covers_next_code_line(tmp_path):
+    write_pkg(
+        tmp_path,
+        "def gate(ok):\n"
+        "    # lint: disable=strippable-assert\n"
+        "    assert ok\n",
+    )
+    result = run_analysis(
+        str(tmp_path), ["pkg"], [StrippableAssertRule()]
+    )
+    assert result.findings == []
+    assert result.suppressed == 1
+
+
+def test_marker_inside_string_literal_is_not_a_suppression(tmp_path):
+    # only real COMMENT tokens count: a string containing the marker
+    # must not become an unreviewable escape hatch
+    write_pkg(
+        tmp_path,
+        "def gate(ok):\n"
+        '    assert ok, "see docs: # lint: disable=strippable-assert"\n',
+    )
+    result = run_analysis(
+        str(tmp_path), ["pkg"], [StrippableAssertRule()]
+    )
+    assert len(result.findings) == 1
+    assert result.suppressed == 0
+
+
+def test_standalone_suppression_skips_justification_comments(tmp_path):
+    # README tells authors to justify next to the suppression; the
+    # justification comment must not swallow the suppression target
+    write_pkg(
+        tmp_path,
+        "def gate(ok):\n"
+        "    # lint: disable=strippable-assert\n"
+        "    # justification: exercised only in the debug REPL\n"
+        "    assert ok\n",
+    )
+    result = run_analysis(
+        str(tmp_path), ["pkg"], [StrippableAssertRule()]
+    )
+    assert result.findings == []
+    assert result.suppressed == 1
+
+
+def test_trailing_suppression_on_wrapped_statement(tmp_path):
+    # black-wrapped call: the comment trails the LAST physical line,
+    # the finding anchors to the first — the node's span bridges them
+    write_pkg(
+        tmp_path,
+        "def gate(ok, msg):\n"
+        "    assert (\n"
+        "        ok\n"
+        "    ), msg  # lint: disable=strippable-assert\n",
+    )
+    result = run_analysis(
+        str(tmp_path), ["pkg"], [StrippableAssertRule()]
+    )
+    assert result.findings == []
+    assert result.suppressed == 1
+
+
+def test_with_header_calls_are_visible_to_lock_rules():
+    # `with self._lock, fut.result():` blocks while the first lock is
+    # held — item expressions must be walked with earlier locks pushed
+    from keystone_tpu.analysis.rules import BlockingUnderLockRule
+
+    ctx = FileContext(
+        "m.py", "pkg/m.py",
+        "import threading\n\n\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n\n"
+        "    def bad(self, fut):\n"
+        "        with self._lock, fut.result():\n"
+        "            pass\n",
+    )
+    fs = list(BlockingUnderLockRule().check_file(ctx))
+    assert len(fs) == 1
+    assert "result" in fs[0].message
+
+
+def test_suppression_is_per_rule(tmp_path):
+    write_pkg(
+        tmp_path,
+        "def gate(ok):\n"
+        "    assert ok  # lint: disable=guarded-by\n",
+    )
+    result = run_analysis(
+        str(tmp_path), ["pkg"], [StrippableAssertRule()]
+    )
+    assert len(result.findings) == 1  # wrong rule name: still fires
+
+
+# -- baseline round trip ----------------------------------------------------
+
+
+def test_baseline_round_trip(tmp_path):
+    write_pkg(tmp_path)
+    result = run_analysis(
+        str(tmp_path), ["pkg"], [StrippableAssertRule()]
+    )
+    assert len(result.findings) == 1
+    path = tmp_path / "baseline.json"
+    Baseline.from_findings(
+        result.findings, justification="grandfathered"
+    ).save(str(path))
+
+    loaded = Baseline.load(str(path))
+    assert len(loaded) == 1
+    again = run_analysis(
+        str(tmp_path), ["pkg"], [StrippableAssertRule()]
+    )
+    assert again.unbaselined(loaded) == []
+    assert loaded.stale_entries(again.findings) == []
+    # the file is honest JSON with the justification field
+    doc = json.loads(path.read_text())
+    assert doc["version"] == 1
+    assert doc["findings"][0]["justification"] == "grandfathered"
+
+
+def test_baseline_goes_stale_when_the_line_changes(tmp_path):
+    write_pkg(tmp_path)
+    first = run_analysis(
+        str(tmp_path), ["pkg"], [StrippableAssertRule()]
+    )
+    baseline = Baseline.from_findings(first.findings)
+
+    # fix the offending line: finding disappears, entry is stale
+    write_pkg(tmp_path, "def gate(ok):\n    return bool(ok)\n")
+    fixed = run_analysis(
+        str(tmp_path), ["pkg"], [StrippableAssertRule()]
+    )
+    assert fixed.findings == []
+    assert len(baseline.stale_entries(fixed.findings)) == 1
+
+    # a DIFFERENT assert on the same line number is NOT covered by the
+    # old entry (identity keys on source text, not line numbers)
+    write_pkg(tmp_path, "def gate(ok):\n    assert ok != 1\n")
+    changed = run_analysis(
+        str(tmp_path), ["pkg"], [StrippableAssertRule()]
+    )
+    assert len(changed.unbaselined(baseline)) == 1
+
+
+def test_baseline_survives_unrelated_edits_above(tmp_path):
+    write_pkg(tmp_path)
+    baseline = Baseline.from_findings(
+        run_analysis(
+            str(tmp_path), ["pkg"], [StrippableAssertRule()]
+        ).findings
+    )
+    # push the assert down two lines; identity keys on line TEXT
+    write_pkg(
+        tmp_path,
+        "import os\n\n\ndef gate(ok):\n    assert ok\n",
+    )
+    moved = run_analysis(
+        str(tmp_path), ["pkg"], [StrippableAssertRule()]
+    )
+    assert len(moved.findings) == 1
+    assert moved.unbaselined(baseline) == []
+
+
+def test_duplicate_lines_distinguished_by_index(tmp_path):
+    write_pkg(
+        tmp_path,
+        "def gate(ok):\n    assert ok\n\n\n"
+        "def gate2(ok):\n    assert ok\n",
+    )
+    result = run_analysis(
+        str(tmp_path), ["pkg"], [StrippableAssertRule()]
+    )
+    assert len(result.findings) == 2
+    assert {f.index for f in result.findings} == {0, 1}
+    # baselining only the first leaves the second live
+    baseline = Baseline.from_findings(result.findings[:1])
+    assert len(result.unbaselined(baseline)) == 1
+
+
+def test_parse_error_becomes_finding(tmp_path):
+    write_pkg(tmp_path, "def broken(:\n")
+    result = run_analysis(str(tmp_path), ["pkg"], default_rules())
+    assert len(result.findings) == 1
+    assert result.findings[0].rule == "parse-error"

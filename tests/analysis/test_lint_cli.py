@@ -1,0 +1,152 @@
+"""CLI contract: exit codes, JSON schema, --write-baseline,
+--changed-only plumbing, --list-rules. Everything drives
+``cli.main(argv)`` in-process — no subprocess, no jax import."""
+
+import json
+
+import pytest
+
+from keystone_tpu.analysis.cli import main
+from keystone_tpu.analysis.rules import ALL_RULES
+
+CLEAN = "def add(a, b):\n    return a + b\n"
+DIRTY = "def gate(ok):\n    assert ok\n"
+
+
+def write_proj(tmp_path, source):
+    pkg = tmp_path / "keystone_tpu"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "mod.py").write_text(source)
+    return tmp_path
+
+
+def test_clean_tree_exits_zero(tmp_path, capsys):
+    write_proj(tmp_path, CLEAN)
+    assert main(["--root", str(tmp_path)]) == 0
+    assert "0 finding(s)" in capsys.readouterr().out
+
+
+def test_findings_exit_one_and_render(tmp_path, capsys):
+    write_proj(tmp_path, DIRTY)
+    assert main(["--root", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "strippable-assert" in out
+    assert "keystone_tpu/mod.py:2" in out
+
+
+def test_json_schema(tmp_path, capsys):
+    write_proj(tmp_path, DIRTY)
+    assert main(["--root", str(tmp_path), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == 1
+    assert doc["clean"] is False
+    assert doc["counts"]["findings"] == 1
+    f = doc["findings"][0]
+    assert f["rule"] == "strippable-assert"
+    assert f["path"] == "keystone_tpu/mod.py"
+    assert f["line"] == 2
+    assert doc["rules"] == [cls.name for cls in ALL_RULES]
+
+
+def test_write_baseline_then_clean(tmp_path, capsys):
+    write_proj(tmp_path, DIRTY)
+    assert main(["--root", str(tmp_path), "--write-baseline"]) == 0
+    baseline = tmp_path / "LINT_BASELINE.json"
+    assert baseline.exists()
+    doc = json.loads(baseline.read_text())
+    assert len(doc["findings"]) == 1
+    # the default baseline path is picked up on the next run
+    capsys.readouterr()
+    assert main(["--root", str(tmp_path)]) == 0
+    assert "1 baselined" in capsys.readouterr().out
+
+
+def test_stale_baseline_fails_until_deleted(tmp_path, capsys):
+    write_proj(tmp_path, DIRTY)
+    assert main(["--root", str(tmp_path), "--write-baseline"]) == 0
+    write_proj(tmp_path, CLEAN)  # fixed: entry now stale
+    assert main(["--root", str(tmp_path)]) == 1
+    assert "stale baseline entry" in capsys.readouterr().out
+
+
+def test_bad_baseline_is_usage_error(tmp_path, capsys):
+    write_proj(tmp_path, CLEAN)
+    bad = tmp_path / "LINT_BASELINE.json"
+    bad.write_text("{\"nope\": true}")
+    assert main(["--root", str(tmp_path)]) == 2
+
+
+def test_unknown_option_is_usage_error(tmp_path):
+    assert main(["--root", str(tmp_path), "--frobnicate"]) == 2
+
+
+def test_list_rules(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for cls in ALL_RULES:
+        assert cls.name in out
+
+
+def test_explicit_paths_limit_the_run(tmp_path):
+    root = write_proj(tmp_path, DIRTY)
+    (root / "keystone_tpu" / "clean.py").write_text(CLEAN)
+    assert main(
+        ["--root", str(root), "keystone_tpu/clean.py"]
+    ) == 0
+    assert main(
+        ["--root", str(root), "keystone_tpu/mod.py"]
+    ) == 1
+
+
+def test_nonexistent_path_is_usage_error(tmp_path, capsys):
+    # a typo'd path must fail loudly, not lint nothing and exit 0
+    write_proj(tmp_path, DIRTY)
+    rc = main(["--root", str(tmp_path), "keystone_tpu/engin.py"])
+    assert rc == 2
+    assert "no such path" in capsys.readouterr().err
+
+
+def test_changed_only_rejects_explicit_paths(tmp_path, capsys):
+    write_proj(tmp_path, CLEAN)
+    rc = main(
+        ["--root", str(tmp_path), "--changed-only", "keystone_tpu"]
+    )
+    assert rc == 2
+    assert "mutually exclusive" in capsys.readouterr().err
+
+
+def test_json_files_counts_analyzed_files(tmp_path, capsys):
+    root = write_proj(tmp_path, CLEAN)
+    (root / "keystone_tpu" / "second.py").write_text(CLEAN)
+    assert main(["--root", str(root), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["files"] == 2
+
+
+def test_write_baseline_rejects_scoped_runs(tmp_path, capsys):
+    # a slice regeneration would silently drop other files' entries
+    write_proj(tmp_path, DIRTY)
+    rc = main(
+        ["--root", str(tmp_path), "--write-baseline",
+         "keystone_tpu/mod.py"]
+    )
+    assert rc == 2
+    assert "full run" in capsys.readouterr().err
+    assert not (tmp_path / "LINT_BASELINE.json").exists()
+    assert main(
+        ["--root", str(tmp_path), "--write-baseline", "--changed-only"]
+    ) == 2
+
+
+def test_changed_only_without_git_falls_back(tmp_path, capsys):
+    # tmp_path is no git repo: --changed-only must warn and lint fully
+    write_proj(tmp_path, DIRTY)
+    rc = main(["--root", str(tmp_path), "--changed-only"])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "needs git" in captured.err
+
+
+@pytest.mark.parametrize("flag", ["--baseline", "--root"])
+def test_dangling_option_argument(flag):
+    assert main([flag]) == 2

@@ -1,0 +1,67 @@
+"""THE gate: keystone-lint over ``keystone_tpu/`` itself, inside
+tier-1. A PR that introduces an unbaselined violation of any contract
+rule — an unlocked write to guarded state, blocking work under a lock,
+a strippable assert, a zero-stamped degradable series, a hot-path host
+sync, fault-catalog drift — fails the normal test suite, not a
+separate CI lane. The baseline must stay empty-or-justified: every
+entry carries a justification, and stale entries fail too."""
+
+import json
+import os
+
+from keystone_tpu.analysis.cli import DEFAULT_BASELINE
+from keystone_tpu.analysis.core import Baseline, run_analysis
+from keystone_tpu.analysis.rules import default_rules
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def test_keystone_tpu_is_lint_clean():
+    result = run_analysis(
+        REPO_ROOT, ["keystone_tpu"], default_rules()
+    )
+    baseline = Baseline.load(
+        os.path.join(REPO_ROOT, DEFAULT_BASELINE)
+    )
+    live = result.unbaselined(baseline)
+    assert live == [], (
+        "keystone-lint found unbaselined contract violations:\n"
+        + "\n".join(f.render() for f in live)
+        + "\nFix them, add a justified `# lint: disable=<rule>`, or "
+        "(last resort) baseline them with a justification — see "
+        "README 'Static analysis'."
+    )
+    stale = baseline.stale_entries(result.findings)
+    assert stale == [], (
+        "stale LINT_BASELINE.json entries (the finding was fixed or "
+        "its line changed) — delete them so the baseline only "
+        f"shrinks:\n{json.dumps(stale, indent=2)}"
+    )
+
+
+def test_baseline_entries_are_justified():
+    baseline = Baseline.load(
+        os.path.join(REPO_ROOT, DEFAULT_BASELINE)
+    )
+    unjustified = [
+        e for e in baseline.entries
+        if not str(e.get("justification", "")).strip()
+        or str(e.get("justification", "")).startswith("TODO")
+    ]
+    assert unjustified == [], (
+        "baseline entries without a real justification:\n"
+        + json.dumps(unjustified, indent=2)
+    )
+
+
+def test_cli_gate_matches_library_verdict(capsys):
+    # the exact command CI runs (bin/smoke-lint.sh) must agree with
+    # the library-level run above — exit 0, clean JSON
+    from keystone_tpu.analysis.cli import main
+
+    rc = main(["--root", REPO_ROOT, "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0, doc["findings"]
+    assert doc["clean"] is True
