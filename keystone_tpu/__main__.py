@@ -114,6 +114,13 @@ def main(argv=None) -> int:
               "keystone_tpu/serving/bench.py)")
         print("  serve-gateway  (HTTP request plane over the bench "
               "pipeline; keystone_tpu/gateway/)")
+        print("  serve-router  (fleet tier: cross-host router over N "
+              "serve-gateway replicas — replica registry with "
+              "--replica URLs + POST /registerz self-registration, "
+              "background health probes with half-open recovery, "
+              "least-loaded routing with retry-on-another-replica, "
+              "federated /metrics + /slz over the replicas' scraped "
+              "le buckets, /fleetz roster; keystone_tpu/fleet/)")
         print("  serve-loadgen  (trace-driven open-loop load generator "
               "+ chaos harness against a live gateway; replays "
               "--request-log recordings or synthesizes Poisson/heavy-"
@@ -177,6 +184,10 @@ def main(argv=None) -> int:
         if gateway_port is not None:
             rest = ["--gateway-port", str(gateway_port)] + rest
         return serve_gateway_main(rest)
+    if app == "serve-router":
+        from keystone_tpu.fleet.router import main as serve_router_main
+
+        return serve_router_main(argv[1:])
     if app == "serve-loadgen":
         from keystone_tpu.loadgen.cli import main as serve_loadgen_main
 

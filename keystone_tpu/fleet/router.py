@@ -1,0 +1,728 @@
+"""Fleet router: the cross-host front door over N gateway replicas.
+
+``EnginePool`` lifted one level: the pool's least-loaded / health /
+retry topology applied to whole ``serve-gateway`` PROCESSES instead of
+in-process lanes — the failover-aware frontend shape production model
+servers put in front of predictable replicas (Clockwork, OSDI '20; the
+request plane below it is the Orca-style gateway from PRs 3–5). A
+stdlib ``http.server`` on a daemon thread, same scaffolding as the
+gateway frontend (``observability/httpd.py``). Routes:
+
+- ``POST /predict`` — forwarded VERBATIM (raw bytes, no re-encode) to
+  the least-loaded ready+healthy replica
+  (``fleet/registry.py ReplicaRegistry.pick``). A transport failure,
+  untyped 5xx, or black-holed response is retried ONCE on another
+  replica before anything reaches the client, so a single replica
+  dying mid-request is invisible; typed ``Overloaded`` responses
+  (429/503/504 with the ``overloaded`` body) PROPAGATE verbatim — the
+  shed/expired semantics the gateway computed survive the extra hop —
+  except 503-``closed`` (a draining replica), which fails over to a
+  sibling first and is surfaced only when no replica can answer. An
+  untyped 5xx that REPRODUCES across the retry propagates verbatim as
+  the error it is (the pool's deterministic-error doctrine — a
+  500-ing fleet must look like one, not like a typed shed); only when
+  no replica is reachable at all does the router shed typed itself
+  (503 ``overloaded``/``closed``).
+- ``POST /registerz`` — ``{"url": "http://host:port"}``
+  self-registration (what ``serve-gateway --register`` POSTs at
+  startup); idempotent per URL, so re-registration is a heartbeat.
+- ``GET /fleetz`` — the JSON roster: per-replica health state
+  (healthy / half-open / unhealthy / unreachable), readiness + the
+  burn-state body, load, build info, failure forensics.
+- ``GET /metrics`` — **SLO federation**: every replica's scrape plus
+  the router's own registry merged into ONE exposition
+  (``prometheus.merge_expositions`` — identical-label series sum, so
+  N replicas of one service export one fleet-wide family and
+  ``quantile_from_buckets`` over the merged ``le`` buckets is the
+  TRUE fleet p99, not a quantile of quantiles). Replicas that can't
+  answer the on-demand scrape contribute their last probe's cached
+  body instead.
+- ``GET /slz`` — burn rates of the router's fleet-wide latency SLO
+  (``Slo.latency_from_buckets`` over the merged replica buckets) when
+  one is declared, alongside any replica-local monitors in-process.
+- ``GET /readyz`` — 200 while at least one replica is ready+healthy
+  (the roster state rides in the body), 503 otherwise: the router is
+  a routing signal for the layer above it, same contract as the
+  gateway's.
+- ``GET|POST /chaosz`` — the fault-injection plane, identical to the
+  gateway frontend's: the fleet-level point
+  ``router.replica.blackhole`` (drop a matched replica's /predict
+  responses — a return-path partition) is armed HERE, in the router
+  process, and fires on the forward path.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+from urllib.parse import urlparse
+
+from keystone_tpu.fleet.registry import ReplicaRegistry
+from keystone_tpu.loadgen import faults
+from keystone_tpu.observability import prometheus
+from keystone_tpu.observability import slo as slo_mod
+from keystone_tpu.observability.httpd import BackgroundServer, JsonHandler
+from keystone_tpu.observability.registry import get_global_registry
+
+logger = logging.getLogger(__name__)
+
+# per-attempt forward bound: must EXCEED the gateway's own
+# RESULT_TIMEOUT_S (60 s — a live replica always answers within it)
+# while staying under the loadgen client's lost-declaration bound, so
+# a slow-but-alive replica yields a typed answer, not a lost request
+FORWARD_TIMEOUT_S = 70.0
+
+# the replica latency family the fleet SLO federates over
+FLEET_LATENCY_FAMILY = "keystone_gateway_request_latency_seconds"
+
+
+class ReplicaUnavailable(RuntimeError):
+    """One replica could not produce a response the client should see
+    YET — transport failure, untyped 5xx, black-holed response, or a
+    draining replica's 503-``closed``. ``charge`` says whether the
+    failure is evidence against the replica's health (a drain is
+    not). Two kinds of last-resort payload ride along for when NO
+    sibling can answer either: ``typed`` (a draining replica's typed
+    503, surfaced verbatim) and ``untyped`` (a real error response the
+    replica produced — after the retry reproduces the failure it must
+    PROPAGATE as the error it is, mirroring the pool's
+    deterministic-error doctrine; dressing it up as a typed shed
+    would hide a 500-ing fleet from the exact invariant checker built
+    to catch it)."""
+
+    def __init__(
+        self,
+        detail: str,
+        charge: bool = True,
+        typed: Optional[Tuple[int, bytes]] = None,
+        untyped: Optional[Tuple[int, bytes]] = None,
+    ):
+        super().__init__(detail)
+        self.charge = charge
+        self.typed = typed
+        self.untyped = untyped
+
+
+class RouterMetrics:
+    """The router's own (non-federated) series, merged into
+    ``/metrics`` alongside the replica scrapes."""
+
+    def __init__(self, registry=None, router: str = "router"):
+        reg = registry if registry is not None else get_global_registry()
+        self.registry = reg
+        self.router = router
+        self._requests = reg.counter(
+            "keystone_router_requests_total",
+            "terminal request outcomes through the fleet router",
+            ("router", "status"),
+        )
+        self._retries = reg.counter(
+            "keystone_router_retries_total",
+            "requests retried on another replica after a replica "
+            "failure",
+            ("router",),
+        )
+        self._replicas = reg.gauge(
+            "keystone_router_replicas",
+            "replicas known to the router, by health state",
+            ("router", "state"),
+        )
+
+    def record_outcome(self, status: str) -> None:
+        self._requests.inc((self.router, status))
+
+    def record_retry(self) -> None:
+        self._retries.inc((self.router,))
+
+    def set_replica_states(self, counts: Dict[str, int]) -> None:
+        for state in ("healthy", "half-open", "unhealthy", "unreachable"):
+            self._replicas.set(
+                float(counts.get(state, 0)), (self.router, state)
+            )
+
+    def retry_count(self) -> float:
+        return self._retries.get((self.router,))
+
+    def outcome_count(self, status: str) -> float:
+        return self._requests.get((self.router, status))
+
+
+class _RouterHandler(JsonHandler):
+    def _send_error_json(self, code: int, error: str, **extra) -> None:
+        self._send_json({"error": error, **extra}, code=code)
+
+    @property
+    def fleet(self) -> ReplicaRegistry:
+        return self.server.fleet  # type: ignore[attr-defined]
+
+    @property
+    def metrics(self) -> RouterMetrics:
+        return self.server.metrics  # type: ignore[attr-defined]
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib handler API)
+        path = urlparse(self.path).path
+        try:
+            if path == "/readyz":
+                counts = self.fleet.counts()
+                self.metrics.set_replica_states(counts)
+                routable = sum(
+                    1
+                    for r in self.fleet.replicas()
+                    if r.healthy and r.ready
+                )
+                body = (
+                    f"{'ok' if routable else 'no replica ready'} "
+                    f"({routable}/{len(self.fleet)} replicas ready; "
+                    f"states {json.dumps(counts, sort_keys=True)})\n"
+                )
+                self._send_text(200 if routable else 503, body)
+            elif path == "/healthz":
+                self._send_text(200, "ok\n")
+            elif path == "/fleetz":
+                self._send_json(self.server.fleetz(), indent=1)  # type: ignore[attr-defined]
+            elif path == "/metrics":
+                body = self.server.federated_metrics()  # type: ignore[attr-defined]
+                self._send(
+                    200, body.encode("utf-8"), prometheus.CONTENT_TYPE
+                )
+            elif path == "/slz":
+                self._send_json(slo_mod.slz_status(), indent=1)
+            elif path == "/chaosz":
+                if not self.server.chaos_routes:  # type: ignore[attr-defined]
+                    self._send_error_json(
+                        404, "chaos_routes_disabled",
+                        detail="started with --no-chaosz",
+                    )
+                else:
+                    self._send_json(
+                        faults.get_injector().status(), indent=1
+                    )
+            else:
+                self._send_text(
+                    404,
+                    "not found; try /predict /registerz /fleetz "
+                    "/readyz /healthz /metrics /slz /chaosz\n",
+                )
+        except Exception as e:
+            logger.exception("router GET error for %s", self.path)
+            self._send_error_json(500, "internal", detail=str(e))
+
+    def do_POST(self) -> None:  # noqa: N802 (stdlib handler API)
+        path = urlparse(self.path).path
+        try:
+            if path == "/predict":
+                self._predict()
+            elif path == "/registerz":
+                self._registerz()
+            elif path == "/chaosz":
+                self._chaosz()
+            else:
+                self._send_text(
+                    404, "not found; try /predict /registerz /chaosz\n"
+                )
+        except Exception as e:
+            logger.exception("router POST error for %s", self.path)
+            self._send_error_json(500, "internal", detail=str(e))
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        return self.rfile.read(length) if length else b""
+
+    # -- the fleet hot path -------------------------------------------------
+
+    def _predict(self) -> None:
+        body = self._read_body()
+        if not body:
+            self._send_error_json(
+                400, "bad_request", detail="empty /predict body"
+            )
+            return
+        max_retries = self.server.max_retries  # type: ignore[attr-defined]
+        tried: List = []
+        typed_fallback: Optional[Tuple[int, bytes]] = None
+        untyped_fallback: Optional[Tuple[int, bytes]] = None
+        for _attempt in range(max_retries + 1):
+            replica = self.fleet.pick(exclude=tried)
+            if replica is None:
+                break
+            tried.append(replica)
+            if _attempt > 0:
+                # counted HERE, when a second attempt actually
+                # dispatches — an exhausted pick() is not a retry
+                self.metrics.record_retry()
+            try:
+                status, payload, ctype = self._forward(replica, body)
+            except ReplicaUnavailable as e:
+                if e.charge:
+                    replica.mark_failed(str(e))
+                if e.typed is not None:
+                    typed_fallback = e.typed
+                if e.untyped is not None:
+                    untyped_fallback = e.untyped
+                if _attempt < max_retries:
+                    logger.warning(
+                        "router: replica %s failed a request (%s); "
+                        "retrying on another replica",
+                        replica.name, e,
+                    )
+                continue
+            replica.mark_ok()
+            self.metrics.record_outcome(
+                "ok" if status < 400
+                else "shed" if status in (429, 503, 504)
+                else "error"
+            )
+            self._send(
+                status, payload,
+                ctype or "application/json; charset=utf-8",
+            )
+            return
+        if untyped_fallback is not None:
+            # the failure REPRODUCED (or had no sibling to disprove
+            # it): a real error response propagates as the error it
+            # is — the pool's deterministic-error doctrine. Masking
+            # it as a typed shed would hide a 500-ing fleet from the
+            # invariant checker built to catch exactly that.
+            status, payload = untyped_fallback
+            self.metrics.record_outcome("error")
+            self._send(
+                status, payload, "application/json; charset=utf-8"
+            )
+            return
+        if typed_fallback is not None:
+            # every live replica is draining: surface THEIR typed
+            # answer (503 closed), not a router-invented error
+            status, payload = typed_fallback
+            self.metrics.record_outcome("shed")
+            self._send(
+                status, payload, "application/json; charset=utf-8"
+            )
+            return
+        self.metrics.record_outcome("shed")
+        self._send_json(
+            {
+                "error": "overloaded",
+                "reason": "closed",
+                "detail": (
+                    f"no replica available (tried {len(tried)} of "
+                    f"{len(self.fleet)})"
+                ),
+            },
+            code=503,
+        )
+
+    def _forward(self, replica, body: bytes) -> Tuple[int, bytes, str]:
+        """POST the raw /predict body to one replica. Returns
+        ``(status, payload, content_type)`` for any response the
+        client should see verbatim; raises ``ReplicaUnavailable`` for
+        outcomes worth trying another replica for."""
+        req = urllib.request.Request(
+            replica.url + "/predict",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        timeout = self.server.forward_timeout_s  # type: ignore[attr-defined]
+        replica.begin_request()
+        try:
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    status = resp.status
+                    payload = resp.read()
+                    ctype = resp.headers.get("Content-Type")
+            except urllib.error.HTTPError as e:
+                status = e.code
+                payload = e.read() or b""
+                ctype = e.headers.get("Content-Type")
+                try:
+                    doc = json.loads(payload or b"{}")
+                except ValueError:
+                    doc = {}
+                typed = (
+                    status in (429, 503, 504)
+                    and doc.get("error") == "overloaded"
+                )
+                if not typed and status >= 500:
+                    # an untyped 5xx is replica-specific until a
+                    # sibling reproduces it — same doctrine as the
+                    # pool's retry-to-another-lane. The raw response
+                    # rides along: if every sibling fails too, THIS
+                    # error surfaces verbatim, never a fake typed shed
+                    raise ReplicaUnavailable(
+                        f"untyped {status} from {replica.name}",
+                        untyped=(status, payload),
+                    ) from e
+                if typed and doc.get("reason") == "closed":
+                    # draining: fail over (a healthy sibling should
+                    # answer), keep the typed 503 as the last resort,
+                    # and charge nothing — draining is lifecycle, not
+                    # failure
+                    raise ReplicaUnavailable(
+                        f"{replica.name} draining (typed closed)",
+                        charge=False,
+                        typed=(status, payload),
+                    ) from e
+                # typed shed (429/504) or a client 4xx: the gateway's
+                # verdict about THIS request — propagate verbatim
+            except (TimeoutError, OSError) as e:
+                # URLError (connection refused/reset) and socket
+                # timeouts are both OSError here: the replica process
+                # never produced an answer
+                raise ReplicaUnavailable(
+                    f"{replica.name}: {type(e).__name__}: {e}"
+                ) from e
+        finally:
+            replica.end_request()
+        # chaos point: an armed router.replica.blackhole (typically
+        # matched to one replica by name or registration index) drops
+        # the matched replica's responses AFTER the replica did the
+        # work — a return-path partition. The router must treat it
+        # exactly like a transport failure: retry elsewhere, charge
+        # the replica's health. Unarmed: one attribute read, no ctx
+        # dict built.
+        if faults.armed() and faults.fire(
+            "router.replica.blackhole",
+            {"replica": replica.name, "index": replica.index},
+        ) is not None:
+            raise ReplicaUnavailable(
+                "router.replica.blackhole dropped a response from "
+                f"{replica.name}"
+            )
+        return status, payload, ctype
+
+    # -- membership + chaos surfaces ----------------------------------------
+
+    def _registerz(self) -> None:
+        try:
+            doc = json.loads(self._read_body() or b"{}")
+        except ValueError as e:
+            self._send_error_json(400, "bad_request", detail=str(e))
+            return
+        url = doc.get("url")
+        if not isinstance(url, str):
+            self._send_error_json(
+                400, "bad_request",
+                detail='want {"url": "http://host:port"}',
+            )
+            return
+        try:
+            replica, created = self.fleet.add(url, source="registered")
+        except ValueError as e:
+            self._send_error_json(400, "bad_request", detail=str(e))
+            return
+        self._send_json(
+            {
+                "registered": True,
+                "created": created,
+                "index": replica.index,
+                "replicas": len(self.fleet),
+                "probe_interval_s": self.fleet.probe_interval_s,
+            }
+        )
+
+    def _chaosz(self) -> None:
+        """Arm/disarm fault points in the ROUTER process (the fleet
+        hot path's chaos surface; same contract as the gateway
+        frontend's)."""
+        if not self.server.chaos_routes:  # type: ignore[attr-defined]
+            self._send_error_json(
+                404, "chaos_routes_disabled",
+                detail="started with --no-chaosz",
+            )
+            return
+        injector = faults.get_injector()
+        try:
+            doc = json.loads(self._read_body() or b"{}")
+        except ValueError as e:
+            self._send_error_json(400, "bad_request", detail=str(e))
+            return
+        if "arm" in doc:
+            spec = doc["arm"]
+            if not isinstance(spec, dict) or "point" not in spec:
+                self._send_error_json(
+                    400, "bad_request",
+                    detail='arm wants {"point": ..., [count/delay_ms/'
+                           'for_s/match]}',
+                )
+                return
+            spec = dict(spec)
+            point = spec.pop("point")
+            if point not in faults.FAULT_POINTS:
+                self._send_error_json(
+                    400, "unknown_fault_point", point=point,
+                    known=sorted(faults.FAULT_POINTS),
+                )
+                return
+            try:
+                injector.arm(point, **spec)
+            except (TypeError, ValueError) as e:
+                self._send_error_json(400, "bad_request", detail=str(e))
+                return
+        elif "disarm" in doc:
+            point = doc["disarm"]
+            if point == "*":
+                injector.disarm_all()
+            else:
+                injector.disarm(point)
+        else:
+            self._send_error_json(
+                400, "bad_request",
+                detail='want {"arm": {...}} or {"disarm": "<point>|*"}',
+            )
+            return
+        self._send_json(injector.status(), indent=1)
+
+
+class RouterServer(BackgroundServer):
+    """The fleet router over one ``ReplicaRegistry``. ``start()``
+    binds, serves on a daemon thread, and starts the registry's
+    background health probes; ``stop()`` shuts both down."""
+
+    handler_cls = _RouterHandler
+    thread_name = "keystone-router-http"
+
+    def __init__(
+        self,
+        replicas: Sequence[str] = (),
+        port: int = 0,
+        host: str = "127.0.0.1",
+        *,
+        name: str = "router",
+        registry=None,
+        probe_interval_s: float = 2.0,
+        probe_timeout_s: float = 5.0,
+        unhealthy_after: Optional[int] = None,
+        recovery_after_s: Optional[float] = None,
+        forward_timeout_s: float = FORWARD_TIMEOUT_S,
+        max_retries: int = 1,
+        chaos_routes: bool = True,
+        slo_latency_s: Optional[float] = None,
+        slo_target: float = 0.99,
+        slo_fast_window_s: float = 60.0,
+        slo_slow_window_s: float = 1800.0,
+        slo_sample_interval_s: float = 5.0,
+    ):
+        super().__init__(port=port, host=host)
+        if max_retries < 0:
+            raise ValueError(
+                f"max_retries must be >= 0, got {max_retries}"
+            )
+        self.name = name
+        self.registry = (
+            registry if registry is not None else get_global_registry()
+        )
+        self.metrics = RouterMetrics(registry=self.registry, router=name)
+        kwargs: Dict[str, Any] = {}
+        if unhealthy_after is not None:
+            kwargs["unhealthy_after"] = unhealthy_after
+        if recovery_after_s is not None:
+            kwargs["recovery_after_s"] = recovery_after_s
+        self.fleet = ReplicaRegistry(
+            replicas,
+            probe_interval_s=probe_interval_s,
+            probe_timeout_s=probe_timeout_s,
+            name=name,
+            **kwargs,
+        )
+        self.forward_timeout_s = float(forward_timeout_s)
+        self.max_retries = int(max_retries)
+        self.chaos_routes = bool(chaos_routes)
+        self._started_t = time.time()
+        # -- the fleet-wide SLO (federated burn rates at /slz) -------------
+        self.slo_monitor: Optional[slo_mod.SloMonitor] = None
+        self._slo_sample_interval_s = float(slo_sample_interval_s)
+        if slo_latency_s is not None:
+            self.slo_monitor = slo_mod.SloMonitor(
+                fast_window_s=slo_fast_window_s,
+                slow_window_s=slo_slow_window_s,
+                registry=self.registry,
+            )
+            self.slo_monitor.add(
+                slo_mod.Slo.latency_from_buckets(
+                    f"{name}:fleet_latency",
+                    self.federated_latency_buckets,
+                    threshold_s=slo_latency_s,
+                    target=slo_target,
+                )
+            )
+
+    # -- federation ---------------------------------------------------------
+
+    def federated_latency_buckets(self) -> List[Tuple[float, float]]:
+        """The fleet-wide cumulative latency buckets: every replica's
+        cached ``keystone_gateway_request_latency_seconds`` buckets
+        merged (label-agnostic — distinctly-named gateways still sum
+        into one fleet distribution)."""
+        return prometheus.merge_histograms(
+            [
+                prometheus.histogram_buckets(text, FLEET_LATENCY_FAMILY)
+                for text in self.fleet.scrapes()
+            ]
+        )
+
+    def federated_metrics(self) -> str:
+        """The ``/metrics`` body: on-demand replica scrapes (cached
+        fallback for unreachable replicas) + the router's own
+        registry, merged into one exposition. Conflicting histogram
+        layouts drop (logged) rather than failing the whole fleet
+        scrape."""
+        own = prometheus.render(self.registry.collect())
+        return prometheus.merge_expositions(
+            [own] + self.fleet.fresh_scrapes(), on_conflict="drop"
+        )
+
+    def fleetz(self) -> Dict:
+        """The ``/fleetz`` document: router identity + the roster."""
+        doc = self.fleet.roster()
+        counts = doc["counts"]
+        self.metrics.set_replica_states(counts)
+        doc["router"] = {
+            "name": self.name,
+            "uptime_s": round(time.time() - self._started_t, 1),
+            "max_retries": self.max_retries,
+            "forward_timeout_s": self.forward_timeout_s,
+            "slo": (
+                [s.name for s in self.slo_monitor.slos]
+                if self.slo_monitor is not None
+                else []
+            ),
+        }
+        return doc
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _configure(self, httpd) -> None:
+        httpd.fleet = self.fleet
+        httpd.metrics = self.metrics
+        httpd.max_retries = self.max_retries
+        httpd.forward_timeout_s = self.forward_timeout_s
+        httpd.chaos_routes = self.chaos_routes
+        httpd.federated_metrics = self.federated_metrics
+        httpd.fleetz = self.fleetz
+
+    def start(self) -> "RouterServer":
+        super().start()
+        self.fleet.start()
+        if self.slo_monitor is not None:
+            self.slo_monitor.start(self._slo_sample_interval_s)
+        return self
+
+    def stop(self) -> None:
+        if self.slo_monitor is not None:
+            self.slo_monitor.stop()
+        self.fleet.stop()
+        super().stop()
+
+
+def main(argv=None) -> int:
+    """``python -m keystone_tpu serve-router --replica URL ...`` —
+    stand up the fleet tier over running ``serve-gateway`` replicas
+    (or an empty roster that fills via ``--register``
+    self-registration)."""
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(
+        prog="keystone_tpu serve-router", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--router-port", "--port", dest="port", type=int,
+                    default=0, help="bind port (0 = ephemeral)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--replica", action="append", default=[],
+                    metavar="URL",
+                    help="a gateway replica's base URL (repeatable); "
+                    "replicas can also self-register via POST "
+                    "/registerz (serve-gateway --register)")
+    ap.add_argument("--probe-interval", type=float, default=2.0,
+                    help="seconds between background health probes")
+    ap.add_argument("--probe-timeout", type=float, default=5.0)
+    ap.add_argument("--unhealthy-after", type=int, default=None,
+                    help="consecutive request failures that bench a "
+                    "replica (default 3, mirroring the lane pool)")
+    ap.add_argument("--recovery-after", type=float, default=None,
+                    help="seconds a benched replica sits out before "
+                    "half-open probe traffic (default 5)")
+    ap.add_argument("--forward-timeout", type=float,
+                    default=FORWARD_TIMEOUT_S)
+    ap.add_argument("--max-retries", type=int, default=1,
+                    help="retries on ANOTHER replica after a replica "
+                    "failure before the error surfaces")
+    ap.add_argument("--slo-latency-ms", type=float, default=None,
+                    help="declare a FLEET-WIDE latency SLO at this "
+                    "threshold: burn rates computed over the "
+                    "federated le buckets, served at /slz")
+    ap.add_argument("--slo-target", type=float, default=0.99)
+    ap.add_argument("--no-chaosz", action="store_true",
+                    help="disable the /chaosz fault-injection routes "
+                    "on this router")
+    args = ap.parse_args(argv)
+    server = RouterServer(
+        args.replica,
+        port=args.port,
+        host=args.host,
+        probe_interval_s=args.probe_interval,
+        probe_timeout_s=args.probe_timeout,
+        unhealthy_after=args.unhealthy_after,
+        recovery_after_s=args.recovery_after,
+        forward_timeout_s=args.forward_timeout,
+        max_retries=args.max_retries,
+        chaos_routes=not args.no_chaosz,
+        slo_latency_s=(
+            args.slo_latency_ms / 1e3
+            if args.slo_latency_ms is not None else None
+        ),
+        slo_target=args.slo_target,
+    ).start()
+    # chaos experiments can pre-arm fleet fault points from the
+    # environment (KEYSTONE_FAULTS="router.replica.blackhole=..."),
+    # same contract as the serving CLIs
+    faults.arm_from_env()
+    # the machine-parseable bound-address line FIRST (smoke scripts
+    # and drills launch with --port 0 and read this, no port races),
+    # then the human summary
+    print(
+        json.dumps(
+            {
+                "listening": server.url().rstrip("/"),
+                "role": "router",
+                "replicas": [r.url for r in server.fleet.replicas()],
+            }
+        ),
+        flush=True,
+    )
+    print(
+        f"router: {server.url()} (POST /predict, POST /registerz, "
+        "GET /fleetz, GET /readyz, GET /metrics, GET /slz, "
+        "GET|POST /chaosz)",
+        flush=True,
+    )
+    stop = threading.Event()
+
+    def handle(signum, frame):
+        logger.info("router: signal %d, stopping", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, handle)
+    signal.signal(signal.SIGINT, handle)
+    try:
+        while not stop.wait(0.5):
+            pass
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    return 0
+
+
+__all__ = [
+    "FORWARD_TIMEOUT_S",
+    "ReplicaUnavailable",
+    "RouterMetrics",
+    "RouterServer",
+    "main",
+]

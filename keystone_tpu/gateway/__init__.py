@@ -18,8 +18,10 @@ PR 1 built the per-engine fast path (``CompiledPipeline`` +
   ``close()``/SIGTERM.
 - ``GatewayServer`` (http.py): stdlib HTTP frontend — ``POST
   /predict``, ``GET /readyz`` (readiness, distinct from the admin
-  plane's ``/healthz`` liveness), ``GET /metrics``, ``POST /swap``,
-  ``POST /drain``.
+  plane's ``/healthz`` liveness; carries the ``X-Keystone-Load``
+  header the fleet router's probes read), ``GET /metrics``,
+  ``POST /swap``, ``POST /drain``, and ``--register`` to self-join a
+  ``keystone_tpu/fleet`` router's replica set.
 
 Everything publishes through the PR 2 observability plane:
 ``keystone_gateway_shed_total``, ``keystone_gateway_retries_total``,

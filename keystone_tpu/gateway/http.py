@@ -16,9 +16,11 @@ A stdlib ``http.server`` on a background daemon thread, following the
   "is the process up", this answers "should the load balancer route
   here" — a draining gateway is alive but not ready. With SLOs
   declared, an active burn/pressure state is appended to the body
-  (still 200: burning means "send less", not "stop sending"). A
-  convenience ``GET /healthz`` is also served for single-port
-  deployments.
+  (still 200: burning means "send less", not "stop sending"). Every
+  response carries an ``X-Keystone-Load`` header (queued + in-lane
+  requests) — the fleet router's probes read this replica's routing
+  load from the same request its health comes from. A convenience
+  ``GET /healthz`` is also served for single-port deployments.
 - ``GET /metrics`` — Prometheus exposition of the (global) registry,
   so a gateway-only deployment is scrapeable without the admin server
   (latency-histogram buckets carry ``trace_id`` exemplars).
@@ -128,6 +130,15 @@ class _Handler(JsonHandler):
         path = url.path
         try:
             if path == "/readyz":
+                # the load-report header: queued + in-lane requests,
+                # so the fleet router's probe reads this replica's
+                # routing load without a full /metrics scrape
+                load_headers = {
+                    "X-Keystone-Load": str(
+                        self.gateway.admission.queue_depth
+                        + self.gateway.pool.total_load()
+                    )
+                }
                 if self.gateway.ready:
                     status = self.gateway.slo_status()
                     if status is not None and (
@@ -141,11 +152,16 @@ class _Handler(JsonHandler):
                             "ok (slo burning: "
                             f"pressure={status['pressure']:.2f} "
                             f"fast={status['burn_rate'].get('fast')})\n",
+                            headers=load_headers,
                         )
                     else:
-                        self._send_text(200, "ok\n")
+                        self._send_text(
+                            200, "ok\n", headers=load_headers
+                        )
                 else:
-                    self._send_text(503, "draining\n")
+                    self._send_text(
+                        503, "draining\n", headers=load_headers
+                    )
             elif path == "/healthz":
                 self._send_text(200, "ok\n")
             elif path == "/metrics":
@@ -539,6 +555,44 @@ class GatewayServer(BackgroundServer, device_obs.MemorySamplerHost):
                 self._request_log_file = None
 
 
+def register_with_router(
+    router_url: str,
+    own_url: str,
+    attempts: int = 30,
+    interval_s: float = 1.0,
+) -> bool:
+    """POST this gateway's base URL to a fleet router's ``/registerz``
+    (``serve-gateway --register``). Retries: replicas and their router
+    launch concurrently, so the router may not be listening yet — the
+    registration is idempotent per URL, a later success is as good as
+    a first one."""
+    import urllib.request
+
+    body = json.dumps({"url": own_url.rstrip("/")}).encode("utf-8")
+    endpoint = router_url.rstrip("/") + "/registerz"
+    for attempt in range(attempts):
+        try:
+            req = urllib.request.Request(
+                endpoint,
+                data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10):
+                logger.info(
+                    "registered %s with router %s", own_url, router_url
+                )
+                return True
+        except Exception as e:
+            if attempt == attempts - 1:
+                logger.warning(
+                    "could not register with router %s after %d "
+                    "attempts: %s", router_url, attempts, e,
+                )
+            time.sleep(interval_s)
+    return False
+
+
 def main(argv=None) -> int:
     """``python -m keystone_tpu serve-gateway [--gateway-port N] ...`` —
     stand up the full request plane over the serve-bench pipeline (the
@@ -594,6 +648,20 @@ def main(argv=None) -> int:
                     "on this frontend (for serving deployments that "
                     "are not chaos experiments; faults stay armable "
                     "in-process via code/env)")
+    ap.add_argument("--register", action="append", default=[],
+                    metavar="ROUTER_URL",
+                    help="self-register this replica with a fleet "
+                    "router (POST {url} to ROUTER_URL/registerz, "
+                    "retried in the background; repeatable). The "
+                    "router probes /readyz and scrapes /metrics from "
+                    "then on — see keystone_tpu/fleet/")
+    ap.add_argument("--advertise-url", default=None, metavar="URL",
+                    help="the base URL to register (and for the "
+                    "router to reach this replica at). Required for "
+                    "real cross-host serving with --host 0.0.0.0: "
+                    "the default advertises the BIND address, and "
+                    "http://0.0.0.0:PORT means 'myself' to the "
+                    "router, not to this replica")
     ap.add_argument("--d", type=int, default=256)
     ap.add_argument("--hidden", type=int, default=512)
     ap.add_argument("--depth", type=int, default=4)
@@ -653,12 +721,31 @@ def main(argv=None) -> int:
         request_log=args.request_log,
         chaos_routes=not args.no_chaosz,
     ).start()
+    # the machine-parseable bound-address line FIRST: with --port 0
+    # (ephemeral — no port races) smoke scripts and the fleet drills
+    # read the actual address off this one JSON line instead of
+    # scraping the human summary below
+    print(
+        json.dumps(
+            {"listening": server.url().rstrip("/"), "role": "gateway"}
+        ),
+        flush=True,
+    )
     print(
         f"gateway: {server.url()} (POST /predict, GET /readyz, "
         "GET /metrics, GET /slz, GET /debugz, GET /profilez, "
         "POST /swap, POST /drain, GET|POST /chaosz)",
         flush=True,
     )
+    advertised = args.advertise_url or server.url()
+    for router_url in args.register:
+        # background: registration retries must not delay serving
+        threading.Thread(
+            target=register_with_router,
+            args=(router_url, advertised),
+            name="keystone-gateway-register",
+            daemon=True,
+        ).start()
     try:
         while gateway.ready:
             time.sleep(0.5)

@@ -8,6 +8,13 @@ for HTTP targets so the fault fires in the SERVER process), runs the
 invariant checker over the result, prints the structured verdict, and
 exits nonzero when the verdict is red.
 
+``--target`` takes a fleet ROUTER's URL just as well as a single
+gateway's: the router serves the same ``/predict`` / ``/readyz`` /
+``/chaosz`` surface, so cross-host drills (kill a replica process
+mid-load, black-hole one replica's responses via
+``router.replica.blackhole``) run through the identical harness —
+``bin/smoke-fleet.sh`` is exactly that.
+
 Examples::
 
     # replay a recorded trace at 4x against a live gateway

@@ -83,6 +83,14 @@ FAULT_POINTS: Dict[str, str] = {
         "engine swap (rebucket force=True) on a background thread "
         "(match: gateway=<name>)"
     ),
+    "router.replica.blackhole": (
+        "drop @ fleet/router.py _forward — the fleet router drops "
+        "the matched replica's /predict responses after the replica "
+        "did the work (a return-path partition); the router's "
+        "retry-on-another-replica + replica health machinery must "
+        "absorb it (match: replica=<host:port> or index=<registration "
+        "order>)"
+    ),
 }
 
 # points whose semantics are "arming IS the event" (no inline call

@@ -25,10 +25,18 @@ class JsonHandler(BaseHTTPRequestHandler):
     handlers (scrapes/probes hit every few seconds; request logs go to
     DEBUG instead of stderr)."""
 
-    def _send(self, code: int, body: bytes, content_type: str) -> None:
+    def _send(
+        self,
+        code: int,
+        body: bytes,
+        content_type: str,
+        headers: Optional[dict] = None,
+    ) -> None:
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, str(value))
         self.end_headers()
         self.wfile.write(body)
 
@@ -41,9 +49,14 @@ class JsonHandler(BaseHTTPRequestHandler):
             "application/json; charset=utf-8",
         )
 
-    def _send_text(self, code: int, text: str) -> None:
+    def _send_text(
+        self, code: int, text: str, headers: Optional[dict] = None
+    ) -> None:
         self._send(
-            code, text.encode("utf-8"), "text/plain; charset=utf-8"
+            code,
+            text.encode("utf-8"),
+            "text/plain; charset=utf-8",
+            headers=headers,
         )
 
     def log_message(self, format, *args):  # noqa: A002 (stdlib API)

@@ -28,6 +28,7 @@ overhead for processes that never declare an objective.
 from __future__ import annotations
 
 import logging
+import math
 import threading
 import time
 import weakref
@@ -139,6 +140,79 @@ class Slo:
                 f"(declared {threshold_s * 1e3:g}ms)"
             ),
             threshold_s=effective,
+        )
+
+    @classmethod
+    def latency_from_buckets(
+        cls,
+        name: str,
+        read_buckets: Callable[[], Sequence[Tuple[float, float]]],
+        threshold_s: float,
+        target: float,
+    ) -> "Slo":
+        """"``target`` of requests complete within ``threshold_s``",
+        read from cumulative ``(le, count)`` buckets returned by
+        ``read_buckets()`` — the FEDERATION path: a fleet router has
+        no registry handle on its replicas' latency series, but it
+        does have their scraped ``le`` buckets
+        (``prometheus.histogram_buckets`` per replica merged by
+        ``prometheus.merge_histograms``), and cumulative buckets are
+        the same (total, bad) arithmetic as ``Slo.latency`` — so the
+        fleet-wide burn rate is computed over exactly the series the
+        replicas export, one ``SloMonitor`` above N processes.
+
+        The threshold snaps UP to the smallest FINITE ``le`` bound >=
+        ``threshold_s`` present in each read (same rule as
+        ``Slo.latency``, applied per sample since the layout arrives
+        with the data); an empty read reports ``(0, 0)`` — no fleet
+        traffic yet, nothing burned. A threshold past every finite
+        bound cannot raise at declaration time the way ``Slo.latency``
+        does (the layout isn't known yet), so it clamps DOWN to the
+        largest finite bound instead, with a one-time warning:
+        snapping to ``+Inf`` would count every observation as good —
+        a dead objective that can never burn — while the clamp keeps
+        the SLO live (conservatively strict) and the warning points at
+        the misdeclared threshold."""
+        warned: List[str] = []  # one-time unobservable-threshold flag
+
+        def read() -> Tuple[float, float]:
+            buckets = list(read_buckets() or ())
+            if not buckets:
+                return 0.0, 0.0
+            total = float(buckets[-1][1])
+            good = None
+            for le, count in buckets:
+                if math.isinf(le):
+                    continue
+                if le >= threshold_s:
+                    good = float(count)
+                    break
+            if good is None:
+                finite = [
+                    (le, c) for le, c in buckets if not math.isinf(le)
+                ]
+                if not finite:
+                    return total, 0.0  # +Inf-only layout: unjudgeable
+                if not warned:
+                    warned.append(name)
+                    logger.warning(
+                        "SLO %s: threshold %gs exceeds the largest "
+                        "finite bucket bound (%gs); clamping DOWN to "
+                        "it — declare thresholds on bucket edges",
+                        name, threshold_s, finite[-1][0],
+                    )
+                good = float(finite[-1][1])
+            return total, total - good
+
+        return cls(
+            name,
+            target,
+            read,
+            description=(
+                f"p{target * 100:g} fleet latency <= "
+                f"{threshold_s * 1e3:g}ms (federated le buckets)"
+            ),
+            threshold_s=threshold_s,
         )
 
     @classmethod

@@ -48,6 +48,17 @@
   untyped 500s), readiness recovers once the fault clears, and p99
   returns to within 1.5x the pre-fault value within 10 s of the
   fault clearing. The headline value is the post/pre p99 ratio.
+- ``serving_router_failover`` — the fleet tier's regression row
+  (``--fleet``/``--fleet-only``; run by ``bin/smoke-fleet.sh``):
+  open-loop load through the ``keystone_tpu/fleet/`` router fronting
+  TWO in-process gateway replicas while one replica's responses are
+  black-holed mid-run (``router.replica.blackhole`` — the HTTP-level
+  equivalent of killing the process). The invariant verdict is
+  asserted (nothing lost, typed sheds only, readiness holds,
+  recovered p99 within 1.5x pre-fault) and the headline fleet p99
+  comes from the ROUTER'S OWN federated ``/metrics`` — per-replica
+  ``le`` buckets merged by ``prometheus.merge_histograms`` — so the
+  row proves the federation surface, not a bench-local stopwatch.
 
 Callable standalone (``python -m keystone_tpu serve-bench``) or from
 the repo-level ``bench.py`` which passes its own ``emit`` so rows land
@@ -1010,6 +1021,209 @@ def bench_chaos_prep_stall(
     )
 
 
+def bench_router_failover(
+    emit, fitted, buckets: Sequence[int], d: int,
+    n_requests: int = 300, rate: float = 30.0,
+) -> None:
+    """``serving_router_failover`` — the fleet tier's acceptance row:
+    a ``RouterServer`` fronting TWO in-process gateway replicas (each
+    on a private registry, scraped over real HTTP), open-loop load
+    through the router, and replica #1's responses black-holed for
+    1.5 s mid-run (``router.replica.blackhole`` matched to its
+    registration index — every answer it produces is dropped on the
+    return path, the network-level equivalent of the process dying).
+    The router must route around it: invariant verdict asserted
+    (every admitted request resolves, typed sheds only, the router's
+    ``/readyz`` holds, recovered p99 within 1.5x pre-fault), the
+    injection count audited, and the headline fleet p99 computed from
+    the router's own federated ``/metrics`` by merging the two
+    replicas' scraped ``le`` buckets — with both replicas required to
+    have actually served (a merge of one replica proves nothing)."""
+    import urllib.request
+
+    import jax.numpy as jnp
+
+    from keystone_tpu.fleet import RouterServer
+    from keystone_tpu.gateway import Gateway, GatewayServer
+    from keystone_tpu.loadgen import faults, synthesize
+    from keystone_tpu.loadgen.invariants import InvariantChecker
+    from keystone_tpu.loadgen.runner import (
+        FaultPlan,
+        HttpTarget,
+        LoadGenerator,
+    )
+    from keystone_tpu.observability.prometheus import (
+        histogram_buckets,
+        merge_histograms,
+        quantile_from_buckets,
+    )
+    from keystone_tpu.observability.registry import MetricsRegistry
+
+    point = "router.replica.blackhole"
+    fired_before = faults.get_injector().fired_count(point)
+    replicas = []
+    router = None
+    try:
+        for i in range(2):
+            # private registry per replica: in one process the two
+            # "hosts" must not share metric series, exactly like real
+            # processes wouldn't — the router only ever sees their
+            # /metrics scrapes
+            reg = MetricsRegistry()
+            gw = Gateway(
+                fitted, buckets=buckets, n_lanes=2, max_delay_ms=2.0,
+                warmup_example=jnp.zeros((d,), jnp.float32),
+                name=f"bench-fleet-r{i}", registry=reg,
+            )
+            srv = GatewayServer(gw, port=0, registry=reg).start()
+            replicas.append((gw, srv))
+        router = RouterServer(
+            [srv.url() for _, srv in replicas],
+            port=0,
+            name="bench-router",
+            registry=MetricsRegistry(),
+            probe_interval_s=0.25,
+            recovery_after_s=1.0,
+        ).start()
+        router.fleet.probe_once()  # don't race the first probe tick
+        # rate sized for the WORST case this row runs in: replicas,
+        # router, and 100+ client threads all share one CPU process
+        # (GIL and all), so a saturating rate would turn the post-fault
+        # backlog drain into a p99-recovery failure that has nothing
+        # to do with the router. The arrival tail (10 s of traffic vs
+        # a 3.5 s fault window) is what recovery is measured ON —
+        # arrivals that stop at the fault's edge leave the recovery
+        # invariant nothing to observe.
+        events = synthesize(
+            n_requests, arrivals="poisson", rate=rate, shape=(d,),
+            seed=13,
+        )
+        # bounded outstanding for the same reason: on a small CI host
+        # 128 client threads thrash the GIL against the servers and
+        # the backlog's drain — not the router — becomes the tail
+        gen = LoadGenerator(
+            HttpTarget(router.url(), default_shape=(d,)),
+            max_outstanding=32,
+        )
+        report = gen.run(
+            events,
+            faults=[FaultPlan(
+                spec={"point": point, "match": {"index": 1}},
+                at_s=2.0, for_s=1.5,
+            )],
+            settle_s=3.0,
+            recovery_probe_s=10.0,
+        )
+        verdict = InvariantChecker(
+            p99_factor=1.5, recovery_within_s=10.0, max_shed_rate=0.9,
+        ).check(report)
+        injections = (
+            faults.get_injector().fired_count(point) - fired_before
+        )
+        with urllib.request.urlopen(
+            router.url("/metrics"), timeout=15
+        ) as resp:
+            federated = resp.read().decode("utf-8")
+        with urllib.request.urlopen(
+            router.url("/fleetz"), timeout=15
+        ) as resp:
+            roster = json.loads(resp.read())
+        retries = router.metrics.retry_count()
+    finally:
+        if router is not None:
+            router.stop()
+        for gw, srv in replicas:
+            gw.close()
+            srv.stop()
+    per_replica = [
+        histogram_buckets(
+            federated,
+            "keystone_gateway_request_latency_seconds",
+            {"gateway": f"bench-fleet-r{i}"},
+        )
+        for i in range(2)
+    ]
+    served_per = [b[-1][1] if b else 0.0 for b in per_replica]
+    # explicit raises, not asserts: python -O must not strip the
+    # row's acceptance contract
+    if min(served_per) <= 0:
+        raise RuntimeError(
+            "serving_router_failover: a replica served nothing "
+            f"(per-replica request counts {served_per}) — the fleet "
+            "number would be one replica's, not a federation"
+        )
+    fleet_buckets = merge_histograms(per_replica)
+    fleet_p99 = quantile_from_buckets(0.99, fleet_buckets)
+    if fleet_p99 is None:
+        raise RuntimeError(
+            "serving_router_failover: the router's federated "
+            "/metrics had no latency buckets:\n" + federated
+        )
+    if injections <= 0:
+        raise RuntimeError(
+            "serving_router_failover: router.replica.blackhole never "
+            "fired — the experiment proved nothing"
+        )
+    if not verdict.passed:
+        raise RuntimeError(
+            "serving_router_failover: serving invariants violated "
+            "under replica loss:\n" + verdict.to_json()
+        )
+    stats = verdict.stats
+    pre = stats.get("pre_fault_p99_ms")
+    post = stats.get("recovered_p99_ms")
+    if post is None:
+        post = stats.get("post_fault_p99_ms")
+    emit(
+        "serving_router_failover",
+        fleet_p99 * 1e3, "ms",
+        extra={
+            "source": "router's federated /metrics "
+                      "(merge_histograms over per-replica le buckets)",
+            "verdict": "green" if verdict.passed else "red",
+            "invariants": [r.name for r in verdict.invariants],
+            "fault": "router.replica.blackhole index=1 for 1.5s",
+            "injections": injections,
+            "router_retries": int(retries),
+            "requests": stats["issued"],
+            "resolved": stats["resolved"],
+            "untyped_failures": stats["untyped_failures"],
+            "lost": stats["lost"],
+            "shed_rate": stats["shed_rate"],
+            "pre_fault_p99_ms": pre,
+            "during_fault_p99_ms": stats.get("during_fault_p99_ms"),
+            "recovered_p99_ms": stats.get("recovered_p99_ms"),
+            "p99_post_over_pre": (
+                round(post / pre, 3)
+                if pre and post is not None else None
+            ),
+            "per_replica_requests": served_per,
+            "per_replica_p99_ms": [
+                round(q * 1e3, 3) if q is not None else None
+                for q in (
+                    quantile_from_buckets(0.99, b) for b in per_replica
+                )
+            ],
+            "fleet_states": roster.get("counts"),
+        },
+    )
+
+
+def run_fleet_benches(
+    emit,
+    d: int = 256,
+    hidden: int = 512,
+    depth: int = 4,
+    buckets: Sequence[int] = (8, 32, 128),
+    fitted=None,
+) -> None:
+    """The fleet-tier row alone (bin/smoke-fleet.sh's entry; ~10 s of
+    sustained load through a real router + two HTTP replicas)."""
+    if fitted is None:
+        fitted = build_pipeline(d, hidden, depth)
+    bench_router_failover(emit, fitted, buckets, d)
+
+
 def run_serving_benches(
     emit,
     d: int = 256,
@@ -1018,6 +1232,7 @@ def run_serving_benches(
     buckets: Sequence[int] = (8, 32, 128),
     chaos: bool = False,
     cold_start: bool = True,
+    fleet: bool = False,
 ) -> None:
     fitted = build_pipeline(d, hidden, depth)
     bench_cold_vs_warm(emit, fitted, buckets, d)
@@ -1054,6 +1269,9 @@ def run_serving_benches(
             )
     if chaos:
         run_chaos_benches(emit, d=d, hidden=hidden, depth=depth,
+                          buckets=buckets, fitted=fitted)
+    if fleet:
+        run_fleet_benches(emit, d=d, hidden=hidden, depth=depth,
                           buckets=buckets, fitted=fitted)
 
 
@@ -1114,6 +1332,17 @@ def main(argv=None) -> int:
     ap.add_argument("--chaos-only", action="store_true",
                     help="run ONLY the chaos rows (what "
                     "bin/smoke-chaos.sh invokes)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="also run the fleet-tier row "
+                    "(serving_router_failover): open-loop load "
+                    "through the cross-host router + two in-process "
+                    "HTTP replicas with one replica black-holed "
+                    "mid-run, invariant verdict asserted and the "
+                    "fleet p99 read from the router's federated "
+                    "/metrics (~10s)")
+    ap.add_argument("--fleet-only", action="store_true",
+                    help="run ONLY the fleet-tier row (what "
+                    "bin/smoke-fleet.sh invokes)")
     ap.add_argument("--no-cold-start", action="store_true",
                     help="skip the serving_cold_start_aot row (it "
                     "spawns fresh gateway subprocesses and takes "
@@ -1143,7 +1372,12 @@ def main(argv=None) -> int:
         print(json.dumps(row), flush=True)
 
     def run():
-        if args.chaos_only:
+        if args.fleet_only:
+            run_fleet_benches(
+                emit, d=args.d, hidden=args.hidden, depth=args.depth,
+                buckets=buckets,
+            )
+        elif args.chaos_only:
             run_chaos_benches(
                 emit, d=args.d, hidden=args.hidden, depth=args.depth,
                 buckets=buckets,
@@ -1153,6 +1387,7 @@ def main(argv=None) -> int:
                 emit, d=args.d, hidden=args.hidden, depth=args.depth,
                 buckets=buckets, chaos=args.chaos,
                 cold_start=not args.no_cold_start,
+                fleet=args.fleet,
             )
 
     if args.profile_dir:

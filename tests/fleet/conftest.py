@@ -1,0 +1,33 @@
+import os
+import sys
+
+import pytest
+
+from keystone_tpu.loadgen import faults
+
+# the shared tiny-pipeline helpers live next to the gateway suite;
+# rootdir conftest only puts tests/ itself on the path
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "gateway",
+    ),
+)
+
+from gateway_fixtures import make_fitted  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def fitted():
+    return make_fitted()
+
+
+@pytest.fixture(autouse=True)
+def clean_injector():
+    """The injector is process-global state: every test starts and
+    ends with nothing armed, so a failing chaos test can't leak its
+    faults into the rest of the suite."""
+    faults.disarm_all()
+    yield
+    faults.disarm_all()

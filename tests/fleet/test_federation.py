@@ -1,0 +1,249 @@
+"""Histogram/exposition federation: golden-string merges of
+per-replica scrapes (summed ``le`` buckets incl. ``+Inf``, counter
+families, gauge summation, metadata carry-over, conflicting-layout
+rejection) and PromQL quantiles over the merged result."""
+
+import math
+
+import pytest
+
+from keystone_tpu.observability.slo import Slo
+from keystone_tpu.observability.prometheus import (
+    histogram_buckets,
+    merge_expositions,
+    merge_histograms,
+    parse_samples,
+    quantile_from_buckets,
+)
+
+INF = float("inf")
+
+SCRAPE_A = """\
+# HELP keystone_gateway_request_latency_seconds end-to-end latency
+# TYPE keystone_gateway_request_latency_seconds histogram
+keystone_gateway_request_latency_seconds_bucket{gateway="g",le="0.1"} 5
+keystone_gateway_request_latency_seconds_bucket{gateway="g",le="0.5"} 8
+keystone_gateway_request_latency_seconds_bucket{gateway="g",le="+Inf"} 10
+keystone_gateway_request_latency_seconds_count{gateway="g"} 10
+keystone_gateway_request_latency_seconds_sum{gateway="g"} 1.5
+# HELP keystone_gateway_requests_total terminal outcomes
+# TYPE keystone_gateway_requests_total counter
+keystone_gateway_requests_total{gateway="g",status="ok"} 10
+"""
+
+SCRAPE_B = """\
+# TYPE keystone_gateway_request_latency_seconds histogram
+keystone_gateway_request_latency_seconds_bucket{gateway="g",le="0.1"} 1
+keystone_gateway_request_latency_seconds_bucket{gateway="g",le="0.5"} 9
+keystone_gateway_request_latency_seconds_bucket{gateway="g",le="+Inf"} 12
+keystone_gateway_request_latency_seconds_count{gateway="g"} 12
+keystone_gateway_request_latency_seconds_sum{gateway="g"} 2.2
+# TYPE keystone_gateway_requests_total counter
+keystone_gateway_requests_total{gateway="g",status="ok"} 12
+keystone_gateway_requests_total{gateway="g",status="shed"} 3
+"""
+
+MERGED_GOLDEN = """\
+# HELP keystone_gateway_request_latency_seconds end-to-end latency
+# TYPE keystone_gateway_request_latency_seconds histogram
+keystone_gateway_request_latency_seconds_bucket{gateway="g",le="0.1"} 6
+keystone_gateway_request_latency_seconds_bucket{gateway="g",le="0.5"} 17
+keystone_gateway_request_latency_seconds_bucket{gateway="g",le="+Inf"} 22
+keystone_gateway_request_latency_seconds_count{gateway="g"} 22
+keystone_gateway_request_latency_seconds_sum{gateway="g"} 3.7
+# HELP keystone_gateway_requests_total terminal outcomes
+# TYPE keystone_gateway_requests_total counter
+keystone_gateway_requests_total{gateway="g",status="ok"} 22
+keystone_gateway_requests_total{gateway="g",status="shed"} 3
+"""
+
+
+# -- merge_histograms (the SLO-federation primitive) -----------------------
+
+
+def test_merge_histograms_sums_by_le():
+    merged = merge_histograms(
+        [
+            [(0.1, 5.0), (0.5, 8.0), (INF, 10.0)],
+            [(0.1, 1.0), (0.5, 9.0), (INF, 12.0)],
+        ]
+    )
+    assert merged == [(0.1, 6.0), (0.5, 17.0), (INF, 22.0)]
+
+
+def test_merge_histograms_skips_empty_and_keeps_layout():
+    merged = merge_histograms([[], [(0.1, 1.0), (INF, 2.0)], []])
+    assert merged == [(0.1, 1.0), (INF, 2.0)]
+    assert merge_histograms([[], []]) == []
+
+
+def test_merge_histograms_collapses_duplicate_le_within_one_scrape():
+    # one scrape can carry several series of the family (two gateways
+    # in one process): same le entries collapse by summing first
+    merged = merge_histograms(
+        [
+            [(0.1, 1.0), (INF, 2.0), (0.1, 3.0), (INF, 4.0)],
+            [(0.1, 10.0), (INF, 20.0)],
+        ]
+    )
+    assert merged == [(0.1, 14.0), (INF, 26.0)]
+
+
+def test_merge_histograms_rejects_conflicting_layouts():
+    with pytest.raises(ValueError, match="conflicting"):
+        merge_histograms(
+            [
+                [(0.1, 1.0), (INF, 2.0)],
+                [(0.25, 1.0), (INF, 2.0)],
+            ]
+        )
+
+
+def test_fleet_quantile_over_merged_buckets():
+    a = [(0.1, 99.0), (0.5, 99.0), (INF, 100.0)]  # fast replica
+    b = [(0.1, 0.0), (0.5, 80.0), (INF, 100.0)]   # slow replica
+    merged = merge_histograms([a, b])
+    q_fleet = quantile_from_buckets(0.5, merged)
+    q_a = quantile_from_buckets(0.5, a)
+    q_b = quantile_from_buckets(0.5, b)
+    # the fleet median is the quantile of the UNION: between the
+    # per-replica medians, equal to neither
+    assert q_a < q_fleet < q_b
+    # and +Inf clamping still behaves on the merge
+    assert quantile_from_buckets(0.999, merged) == 0.5
+
+
+# -- merge_expositions (the router's /metrics body) ------------------------
+
+
+def test_merge_expositions_golden():
+    assert merge_expositions([SCRAPE_A, SCRAPE_B]) == MERGED_GOLDEN
+
+
+def test_merged_body_round_trips_through_the_scrape_parsers():
+    body = merge_expositions([SCRAPE_A, SCRAPE_B])
+    buckets = histogram_buckets(
+        body, "keystone_gateway_request_latency_seconds",
+        {"gateway": "g"},
+    )
+    assert buckets == merge_histograms(
+        [
+            histogram_buckets(
+                t, "keystone_gateway_request_latency_seconds"
+            )
+            for t in (SCRAPE_A, SCRAPE_B)
+        ]
+    )
+    rows = dict(
+        ((name, tuple(sorted(labels.items()))), value)
+        for name, labels, value in parse_samples(body)
+    )
+    key = (
+        "keystone_gateway_requests_total",
+        (("gateway", "g"), ("status", "ok")),
+    )
+    assert rows[key] == 22.0
+
+
+def test_merge_expositions_ratio_families_take_max_not_sum():
+    """Identical-label RATIO gauges federate by worst-case: two
+    replicas each at MFU 0.4 are not a fleet at 0.8, and two burn
+    rates of 0.9 must not sum into a page-worthy fabricated 1.8."""
+    a = (
+        'keystone_serving_mfu{engine="g-lane0"} 0.4\n'
+        'keystone_slo_burn_rate{slo="g:latency",window="fast"} 0.9\n'
+        'keystone_gateway_inflight{gateway="g"} 3\n'
+    )
+    b = (
+        'keystone_serving_mfu{engine="g-lane0"} 0.3\n'
+        'keystone_slo_burn_rate{slo="g:latency",window="fast"} 0.7\n'
+        'keystone_gateway_inflight{gateway="g"} 4\n'
+    )
+    body = merge_expositions([a, b])
+    assert 'keystone_serving_mfu{engine="g-lane0"} 0.4' in body
+    assert (
+        'keystone_slo_burn_rate{slo="g:latency",window="fast"} 0.9'
+        in body
+    )
+    # additive gauges still sum (fleet load truth)
+    assert 'keystone_gateway_inflight{gateway="g"} 7' in body
+
+
+def test_merge_expositions_distinct_labels_coexist():
+    a = 'keystone_gateway_inflight{gateway="r0"} 3\n'
+    b = 'keystone_gateway_inflight{gateway="r1"} 4\n'
+    body = merge_expositions([a, b])
+    assert 'keystone_gateway_inflight{gateway="r0"} 3' in body
+    assert 'keystone_gateway_inflight{gateway="r1"} 4' in body
+
+
+def test_merge_expositions_conflicting_layout_raise_and_drop():
+    conflicted = SCRAPE_B.replace('le="0.5"', 'le="0.25"')
+    with pytest.raises(ValueError, match="conflicting"):
+        merge_expositions([SCRAPE_A, conflicted])
+    body = merge_expositions(
+        [SCRAPE_A, conflicted], on_conflict="drop"
+    )
+    # the un-summable family is gone entirely...
+    assert "keystone_gateway_request_latency_seconds" not in body
+    # ...while the counters still federate
+    assert (
+        'keystone_gateway_requests_total{gateway="g",status="ok"} 22'
+        in body
+    )
+
+
+def test_merge_expositions_rejects_bad_mode():
+    with pytest.raises(ValueError, match="on_conflict"):
+        merge_expositions([SCRAPE_A], on_conflict="ignore")
+
+
+# -- Slo.latency_from_buckets (the fleet-SLO read) -------------------------
+
+
+def test_slo_latency_from_buckets_reads_total_and_bad():
+    buckets = [(0.1, 80.0), (0.5, 95.0), (INF, 100.0)]
+    slo = Slo.latency_from_buckets(
+        "fleet:lat", lambda: buckets, threshold_s=0.1, target=0.99
+    )
+    assert slo.read() == (100.0, 20.0)  # 20 requests over 100ms
+    # snap UP to the next finite bound, same rule as Slo.latency
+    slo = Slo.latency_from_buckets(
+        "fleet:lat2", lambda: buckets, threshold_s=0.2, target=0.99
+    )
+    assert slo.read() == (100.0, 5.0)
+    empty = Slo.latency_from_buckets(
+        "fleet:lat3", lambda: [], threshold_s=0.1, target=0.99
+    )
+    assert empty.read() == (0.0, 0.0)
+
+
+def test_slo_latency_from_buckets_unobservable_threshold_clamps(caplog):
+    """A threshold past every finite bound must NOT snap to +Inf
+    (everything good, a dead objective that can never burn): it
+    clamps DOWN to the largest finite bound with a one-time warning,
+    keeping the SLO live and conservatively strict."""
+    buckets = [(0.1, 80.0), (0.5, 95.0), (INF, 100.0)]
+    slo = Slo.latency_from_buckets(
+        "fleet:dead", lambda: buckets, threshold_s=30.0, target=0.99
+    )
+    with caplog.at_level("WARNING"):
+        assert slo.read() == (100.0, 5.0)  # judged at 0.5s, not +Inf
+        assert slo.read() == (100.0, 5.0)
+    warnings = [
+        r for r in caplog.records if "clamping" in r.getMessage()
+    ]
+    assert len(warnings) == 1  # warned once, not per sample
+
+
+def test_merge_expositions_single_scrape_is_normalizing_identity():
+    body = merge_expositions([SCRAPE_A])
+    assert parse_samples(body) == parse_samples(SCRAPE_A)
+    assert math.isclose(
+        dict(
+            (name, value)
+            for name, labels, value in parse_samples(body)
+            if labels.get("le") == "+Inf"
+        )["keystone_gateway_request_latency_seconds_bucket"],
+        10.0,
+    )

@@ -1,0 +1,150 @@
+"""Replica registry unit coverage: membership, the two-layer health
+state machine with half-open recovery (mirroring ``Lane.healthy``),
+least-loaded picks with the availability-over-purity fallbacks, and
+the ``/fleetz`` roster shape. No sockets here — the probe/HTTP half
+is covered by ``test_router_http.py`` against live servers."""
+
+import time
+
+import pytest
+
+from keystone_tpu.fleet.registry import Replica, ReplicaRegistry
+
+
+def _registry(**kwargs):
+    kwargs.setdefault("probe_interval_s", 60.0)  # probes never fire
+    return ReplicaRegistry(**kwargs)
+
+
+# -- membership ------------------------------------------------------------
+
+
+def test_static_urls_and_registration_dedupe():
+    reg = _registry(urls=["http://127.0.0.1:1", "http://127.0.0.1:2/"])
+    assert len(reg) == 2
+    r, created = reg.add("http://127.0.0.1:3", source="registered")
+    assert created and r.index == 2 and r.source == "registered"
+    # re-registration (trailing slash and all) is a heartbeat
+    r2, created = reg.add("http://127.0.0.1:3/")
+    assert not created and r2 is r
+    assert len(reg) == 3
+    assert [x.index for x in reg.replicas()] == [0, 1, 2]
+
+
+def test_bad_urls_rejected():
+    with pytest.raises(ValueError):
+        Replica("ftp://127.0.0.1:1", index=0)
+    with pytest.raises(ValueError):
+        _registry().add("not a url")
+
+
+# -- the health state machine ----------------------------------------------
+
+
+def test_request_failures_bench_then_half_open_then_restore():
+    r = Replica(
+        "http://127.0.0.1:9", index=0,
+        unhealthy_after=3, recovery_after_s=0.05,
+    )
+    r.record_probe(alive=True, ready=True, detail="ok")
+    assert r.healthy and r.state == "healthy"
+    r.mark_failed("boom")
+    r.mark_failed("boom")
+    assert r.healthy  # two strikes: still in
+    r.mark_failed("boom")
+    assert not r.healthy and r.state == "unhealthy"
+    time.sleep(0.06)
+    # cool-down elapsed: half-open, probe traffic allowed again
+    assert r.healthy and r.state == "half-open"
+    r.mark_ok()
+    assert r.state == "healthy"
+    assert r.status()["consecutive_failures"] == 0
+
+
+def test_probe_liveness_overrides_but_does_not_reset_request_health():
+    r = Replica(
+        "http://127.0.0.1:9", index=0,
+        unhealthy_after=3, recovery_after_s=0.05,
+    )
+    for _ in range(3):
+        r.mark_failed("blackholed")
+    r.record_probe(alive=True, ready=True, detail="ok")
+    # a PASSING probe must not overrule failing traffic: the replica
+    # stays benched until the half-open window, probes notwithstanding
+    assert not r.healthy and r.state == "unhealthy"
+    time.sleep(0.06)
+    assert r.state == "half-open"
+    # and a dead process is out regardless of request history
+    r.mark_ok()
+    r.record_probe(alive=False, detail="probe failed: refused")
+    assert not r.healthy and r.state == "unreachable"
+
+
+# -- routing picks ----------------------------------------------------------
+
+
+def _fleet_of_three():
+    reg = _registry(
+        urls=[f"http://127.0.0.1:{p}" for p in (11, 12, 13)],
+        recovery_after_s=60.0,
+    )
+    replicas = reg.replicas()
+    for i, r in enumerate(replicas):
+        r.record_probe(alive=True, ready=True, detail="ok", load=i)
+    return reg, replicas
+
+
+def test_pick_least_loaded_and_exclude():
+    reg, (r0, r1, r2) = _fleet_of_three()
+    assert reg.pick() is r0
+    assert reg.pick(exclude=[r0]) is r1
+    assert reg.pick(exclude=[r0, r1]) is r2
+    assert reg.pick(exclude=[r0, r1, r2]) is None
+
+
+def test_router_inflight_counts_toward_load():
+    reg, (r0, r1, r2) = _fleet_of_three()
+    for _ in range(3):
+        r0.begin_request()
+    assert r0.load == 3.0
+    assert reg.pick() is r1
+    r0.end_request()
+    assert r0.load == 2.0
+
+
+def test_pick_prefers_ready_then_healthy_then_anything():
+    reg, (r0, r1, r2) = _fleet_of_three()
+    # r0 draining (alive, not ready): skipped while a ready one exists
+    r0.record_probe(alive=True, ready=False, detail="draining", load=0)
+    assert reg.pick() is r1
+    # everyone draining: a healthy-but-unready replica beats nothing
+    for r in (r1, r2):
+        r.record_probe(alive=True, ready=False, detail="draining",
+                       load=r.index)
+    assert reg.pick() is r0
+    # everyone benched: availability over purity (and probe traffic)
+    for r in (r0, r1, r2):
+        for _ in range(3):
+            r.mark_failed("x")
+    assert reg.pick() in (r0, r1, r2)
+
+
+# -- roster -----------------------------------------------------------------
+
+
+def test_roster_shape_and_counts():
+    reg, (r0, r1, r2) = _fleet_of_three()
+    for _ in range(3):
+        r2.mark_failed("kaboom")
+    doc = reg.roster()
+    assert [row["index"] for row in doc["replicas"]] == [0, 1, 2]
+    assert doc["counts"] == {"healthy": 2, "unhealthy": 1}
+    row = doc["replicas"][2]
+    assert row["state"] == "unhealthy" and row["healthy"] is False
+    assert row["last_failure"] == "kaboom"
+    assert doc["replicas"][0]["ready"] is True
+    assert set(row) >= {
+        "url", "name", "index", "source", "ready", "ready_detail",
+        "load", "router_inflight", "consecutive_failures", "build",
+        "state", "healthy",
+    }

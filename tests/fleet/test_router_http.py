@@ -1,0 +1,405 @@
+"""The fleet tier end to end, in-process: a ``RouterServer`` fronting
+two real ``GatewayServer`` replicas over actual sockets — routing,
+retry-on-replica-failure, typed ``Overloaded`` propagation across the
+hop, ``/registerz`` self-registration, the ``/fleetz`` roster through
+a kill/restart cycle, federated ``/metrics``, and the
+``router.replica.blackhole`` chaos point."""
+
+import itertools
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from keystone_tpu.fleet import RouterServer
+from keystone_tpu.gateway import Gateway, GatewayServer
+from keystone_tpu.loadgen import faults
+from keystone_tpu.observability.prometheus import (
+    histogram_buckets,
+    merge_histograms,
+    parse_samples,
+    quantile_from_buckets,
+)
+from keystone_tpu.observability.registry import MetricsRegistry
+
+from gateway_fixtures import D, batch, make_fitted
+
+_ids = itertools.count()
+
+
+def _make_replica(name):
+    """One 'host': gateway + HTTP frontend on a PRIVATE registry
+    (in one test process the replicas must not share series, exactly
+    like real processes wouldn't)."""
+    reg = MetricsRegistry()
+    gw = Gateway(
+        make_fitted(),
+        buckets=(4, 8),
+        n_lanes=1,
+        max_delay_ms=1.0,
+        warmup_example=np.zeros(D, np.float32),
+        name=name,
+        registry=reg,
+    )
+    srv = GatewayServer(gw, port=0, registry=reg).start()
+    return gw, srv
+
+
+@pytest.fixture
+def fleet():
+    """Two replicas + a router with fast probes/recovery."""
+    replicas = [
+        _make_replica(f"fleet-r{next(_ids)}") for _ in range(2)
+    ]
+    router = RouterServer(
+        [srv.url() for _, srv in replicas],
+        port=0,
+        name=f"router{next(_ids)}",
+        registry=MetricsRegistry(),
+        probe_interval_s=0.1,
+        probe_timeout_s=5.0,
+        recovery_after_s=0.3,
+    ).start()
+    router.fleet.probe_once()
+    yield router, replicas
+    router.stop()
+    for gw, srv in replicas:
+        gw.close()
+        srv.stop()
+
+
+def _get(url, timeout=15):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _post(url, doc, timeout=30):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(doc).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _predict(router, n=2, seed=0, **extra):
+    doc = {"instances": batch(n, seed=seed).tolist(), **extra}
+    return _post(router.url("/predict"), doc)
+
+
+# -- plain routing ----------------------------------------------------------
+
+
+def test_predict_routes_and_spreads_load(fleet):
+    router, replicas = fleet
+    for seed in range(6):
+        status, doc = _predict(router, n=2, seed=seed)
+        assert status == 200
+        assert len(doc["predictions"]) == 2
+    served = [
+        gw.metrics.outcome_count("ok") for gw, _ in replicas
+    ]
+    assert sum(served) == 12.0
+    assert router.metrics.outcome_count("ok") == 6.0
+
+
+def test_readyz_and_healthz(fleet):
+    router, _ = fleet
+    status, body = _get(router.url("/readyz"))
+    assert status == 200 and b"2/2 replicas ready" in body
+    assert _get(router.url("/healthz"))[0] == 200
+
+
+def test_client_errors_propagate_without_retry(fleet):
+    router, _ = fleet
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(router.url("/predict"), {"instances": []})
+    assert e.value.code == 400
+    assert json.loads(e.value.read())["error"] == "bad_request"
+    assert router.metrics.retry_count() == 0.0
+
+
+# -- failover + health ------------------------------------------------------
+
+
+def test_killed_replica_routed_around_and_fleetz_tracks_recovery(fleet):
+    router, replicas = fleet
+    gw0, srv0 = replicas[0]
+    # remember the port so the "restart" comes back at the same URL
+    url0 = srv0.url().rstrip("/")
+    port0 = srv0.port
+    # kill the LISTENER abruptly (the process-death analogue: no
+    # drain, connections refused from here on)
+    srv0.stop()
+    # every request still answers: the router either retried onto
+    # replica 1 (request-path failure) or a probe benched replica 0
+    # first and routing skipped it — both are the failover working
+    # (the blackhole test below pins the retry path deterministically)
+    for seed in range(5):
+        status, doc = _predict(router, n=1, seed=seed)
+        assert status == 200
+    # the roster shows the dead replica benched (request-path
+    # failures) or unreachable (once a probe lands)
+    router.fleet.probe_once()
+    _, body = _get(router.url("/fleetz"))
+    roster = json.loads(body)
+    row = next(
+        r for r in roster["replicas"] if r["url"] == url0
+    )
+    assert row["healthy"] is False
+    assert row["state"] in ("unhealthy", "unreachable", "half-open")
+    # router stays ready: one replica is enough
+    assert _get(router.url("/readyz"))[0] == 200
+
+    # "restart the process" at the same address
+    srv0b = GatewayServer(
+        gw0, port=port0, registry=replicas[0][0].metrics.registry
+    ).start()
+    try:
+        import time
+
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            router.fleet.probe_once()
+            replica = next(
+                r for r in router.fleet.replicas() if r.url == url0
+            )
+            if replica.state in ("half-open", "healthy"):
+                break
+            time.sleep(0.05)
+        # half-open: the next request is the probe, and one success
+        # fully restores the replica
+        assert replica.state in ("half-open", "healthy")
+        for seed in range(8):
+            assert _predict(router, n=1, seed=10 + seed)[0] == 200
+        assert replica.state == "healthy"
+    finally:
+        srv0b.stop()
+
+
+def test_typed_overloaded_propagates_when_whole_fleet_drains(fleet):
+    router, replicas = fleet
+    for gw, _ in replicas:
+        gw.close()  # typed 503/closed from every replica
+    router.fleet.probe_once()
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _predict(router, n=1)
+    assert e.value.code == 503
+    doc = json.loads(e.value.read())
+    # the typed semantics survived the extra hop: still an
+    # "overloaded"/"closed" body, never a naked 500
+    assert doc["error"] == "overloaded"
+    assert doc["reason"] == "closed"
+    assert _get(router.url("/readyz"))[0] == 503
+
+
+def test_untyped_500_reproduced_propagates_as_error(fleet):
+    """An untyped 5xx that reproduces on the retry replica must
+    surface AS the error it is — a 500-ing fleet must look like one,
+    never like a typed shed (the invariant checker's cardinal sin
+    would otherwise be invisible behind the router)."""
+    router, replicas = fleet
+    # every lane of every replica fails its dispatch: the gateways
+    # themselves answer 500 prediction_failed (untyped)
+    faults.arm("engine.dispatch.error", for_s=30.0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _predict(router, n=1, seed=3)
+        assert e.value.code == 500
+        doc = json.loads(e.value.read())
+        assert doc.get("error") != "overloaded"
+        assert router.metrics.outcome_count("error") >= 1.0
+    finally:
+        faults.disarm_all()
+
+
+def test_single_dead_replica_counts_no_retry():
+    """keystone_router_retries_total means 'a second attempt actually
+    dispatched' — a fleet with nowhere to retry TO must not count
+    one per request."""
+    gw, srv = _make_replica(f"fleet-r{next(_ids)}")
+    router = RouterServer(
+        [srv.url()], port=0, name=f"router{next(_ids)}",
+        registry=MetricsRegistry(), probe_interval_s=30.0,
+    ).start()
+    try:
+        srv.stop()  # the only replica is gone
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(router.url("/predict"), {"instances": [[0.0] * D]})
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["reason"] == "closed"
+        assert router.metrics.retry_count() == 0.0
+    finally:
+        router.stop()
+        gw.close()
+
+
+def test_no_replicas_sheds_typed():
+    router = RouterServer(
+        [], port=0, name=f"router{next(_ids)}",
+        registry=MetricsRegistry(), probe_interval_s=30.0,
+    ).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(router.url("/predict"), {"instances": [[0.0] * D]})
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["reason"] == "closed"
+    finally:
+        router.stop()
+
+
+# -- self-registration ------------------------------------------------------
+
+
+def test_registerz_adds_probes_and_serves(fleet):
+    router, _ = fleet
+    gw, srv = _make_replica(f"fleet-r{next(_ids)}")
+    try:
+        status, doc = _post(
+            router.url("/registerz"), {"url": srv.url()}
+        )
+        assert status == 200
+        assert doc["registered"] and doc["created"]
+        assert doc["replicas"] == 3
+        # idempotent: re-registration is a heartbeat
+        _, doc = _post(router.url("/registerz"), {"url": srv.url()})
+        assert not doc["created"] and doc["replicas"] == 3
+        router.fleet.probe_once()
+        _, body = _get(router.url("/fleetz"))
+        row = next(
+            r
+            for r in json.loads(body)["replicas"]
+            if r["url"] == srv.url().rstrip("/")
+        )
+        assert row["source"] == "registered"
+        assert row["ready"] is True
+    finally:
+        gw.close()
+        srv.stop()
+
+
+def test_registerz_rejects_garbage(fleet):
+    router, _ = fleet
+    for doc in ({"url": "not a url"}, {"nope": 1}):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(router.url("/registerz"), doc)
+        assert e.value.code == 400
+
+
+# -- federation -------------------------------------------------------------
+
+
+def test_metrics_federates_replica_scrapes(fleet):
+    router, replicas = fleet
+    # drive BOTH replicas directly (sequential requests through the
+    # router all land on the least-loaded first replica — correct
+    # routing, but a one-replica histogram is no federation test)...
+    for gw, srv in replicas:
+        for seed in range(4):
+            status, doc = _post(
+                srv.url("/predict"),
+                {"instances": batch(1, seed=seed).tolist()},
+            )
+            assert status == 200
+    # ...plus traffic through the router itself
+    for seed in range(8):
+        assert _predict(router, n=1, seed=seed)[0] == 200
+    _, body = _get(router.url("/metrics"))
+    text = body.decode("utf-8")
+    # per-replica latency buckets from the ONE federated body merge
+    # into the true fleet histogram
+    per_replica = [
+        histogram_buckets(
+            text, "keystone_gateway_request_latency_seconds",
+            {"gateway": gw.name},
+        )
+        for gw, _ in replicas
+    ]
+    assert all(b for b in per_replica)
+    fleet_buckets = merge_histograms(per_replica)
+    assert fleet_buckets[-1][1] == 16.0  # +Inf count = all requests
+    assert quantile_from_buckets(0.99, fleet_buckets) is not None
+    # outcome counters from both replicas rode along, as did the
+    # router's own series
+    rows = {
+        (name, labels.get("gateway") or labels.get("router")): value
+        for name, labels, value in parse_samples(text)
+        if name in (
+            "keystone_gateway_requests_total",
+            "keystone_router_requests_total",
+        )
+        and labels.get("status") in ("ok", None)
+    }
+    total_ok = sum(
+        v
+        for (name, _), v in rows.items()
+        if name == "keystone_gateway_requests_total"
+    )
+    assert total_ok == 16.0
+    assert (
+        "keystone_router_requests_total",
+        router.name,
+    ) in rows
+
+
+def test_probe_reads_load_header_and_build_info(fleet):
+    router, replicas = fleet
+    router.fleet.probe_once()
+    for replica in router.fleet.replicas():
+        row = replica.status()
+        assert row["ready"] is True
+        # the X-Keystone-Load header parsed to a number (idle: 0)
+        assert row["load"] == 0.0
+        # build info came off the replica's own scrape
+        assert "jax" in row["build"] or row["build"] == {}
+
+
+# -- chaos: the fleet fault point -------------------------------------------
+
+
+def test_blackhole_fault_retried_and_benches_replica(fleet):
+    router, replicas = fleet
+    retries_before = router.metrics.retry_count()
+    fired_before = faults.get_injector().fired_count(
+        "router.replica.blackhole"
+    )
+    # arm over the ROUTER's own /chaosz, like the loadgen would
+    status, doc = _post(router.url("/chaosz"), {
+        "arm": {
+            "point": "router.replica.blackhole",
+            "match": {"index": 0},
+            "count": 3,
+        },
+    })
+    assert status == 200
+    assert "router.replica.blackhole" in doc["armed"]
+    # replica 0's responses drop until its 3 strikes bench it; every
+    # client call still answers 200 via the retry
+    for seed in range(10):
+        assert _predict(router, n=1, seed=seed)[0] == 200
+    fired = faults.get_injector().fired_count(
+        "router.replica.blackhole"
+    ) - fired_before
+    assert fired == 3
+    assert router.metrics.retry_count() - retries_before == 3.0
+    replica0 = next(
+        r for r in router.fleet.replicas() if r.index == 0
+    )
+    assert replica0.state in ("unhealthy", "half-open")
+    _post(router.url("/chaosz"), {"disarm": "*"})
+
+
+def test_chaosz_rejects_unknown_point(fleet):
+    router, _ = fleet
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(router.url("/chaosz"), {"arm": {"point": "not.a.point"}})
+    assert e.value.code == 400
+    assert "router.replica.blackhole" in json.loads(e.value.read())[
+        "known"
+    ]

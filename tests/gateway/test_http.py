@@ -106,6 +106,22 @@ def test_readyz_is_readiness_not_liveness(served):
         _get(srv, "/readyz")
     assert e.value.code == 503
     assert e.value.read().decode() == "draining\n"
+    # the fleet router's probe reads routing load off the same
+    # response — present on the draining answer too
+    assert e.value.headers.get("X-Keystone-Load") == "0"
+
+
+def test_readyz_load_report_header(served):
+    """Every /readyz answer carries X-Keystone-Load (queued + in-lane
+    requests) — the header the fleet registry's probes parse."""
+    _, gw, srv = served
+    with urllib.request.urlopen(srv.url("/readyz"), timeout=15) as resp:
+        load = resp.headers.get("X-Keystone-Load")
+    assert load is not None
+    assert float(load) == 0.0  # idle gateway
+    _post(srv, "/predict", {"instances": batch(2, seed=52).tolist()})
+    with urllib.request.urlopen(srv.url("/readyz"), timeout=15) as resp:
+        assert float(resp.headers.get("X-Keystone-Load")) >= 0.0
 
 
 def test_predict_after_drain_is_503_typed(served):
