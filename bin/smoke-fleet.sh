@@ -22,7 +22,14 @@
 #      half-opens and restores it;
 #   5. SLO federation — histogram_quantile over the router's
 #      federated /metrics must agree with the per-replica quantiles
-#      to within one bucket boundary.
+#      to within one bucket boundary;
+#   6. distributed tracing — one /predict through the three-process
+#      drill must come back with an X-Keystone-Trace id that appears
+#      in BOTH processes' /tracez and stitches at the router's
+#      /debugz?trace_id= into one tree with spans from both processes
+#      and a phase decomposition summing to within 10% of the
+#      measured total (the serving_router_trace_overhead bench row in
+#      step 1 bounds the cost of all this at <= 1.05x p99).
 #
 # CI-friendly: CPU backend, localhost only, ~3 min.
 #
@@ -46,7 +53,9 @@ cleanup() {
 trap cleanup EXIT
 
 D=64
-GW_ARGS=(--d "$D" --hidden "$D" --depth 2 --buckets 4,16 --lanes 2)
+# --trace: replicas adopt the router's W3C traceparent so step 6's
+# stitched-trace assertion has both halves to join
+GW_ARGS=(--d "$D" --hidden "$D" --depth 2 --buckets 4,16 --lanes 2 --trace)
 
 listen_url() {  # listen_url <logfile> — the parseable {"listening": ...} line
     python -c '
@@ -93,25 +102,35 @@ sys.stdout.write(urllib.request.urlopen(sys.argv[1], timeout=float(sys.argv[2]))
 # client threads share this box), so a single red attempt on a loaded
 # host gets one fresh chance (the row is idempotent — the fired-count
 # audit is delta-based) before the smoke fails for real.
-echo "== fleet bench row (in-process router + 2 HTTP replicas) =="
-ROW_OK=""
-for attempt in 1 2; do
-    if JAX_PLATFORMS=cpu PYTHONPATH="$ROOT" \
-        python -m keystone_tpu serve-bench --fleet-only \
-        --d "$D" --hidden "$D" --depth 2 --buckets 4,16 --no-cache \
-        | tee "$BENCH_LOG" \
-        && grep '"metric": "serving_router_failover"' "$BENCH_LOG" \
-            | grep -q '"verdict": "green"'; then
-        ROW_OK=1
-        break
-    fi
-    echo "bench-row attempt $attempt not green; $([ "$attempt" -lt 2 ] \
-        && echo 'retrying once (host-load flake guard)' \
-        || echo 'out of retries')"
-done
-[[ -n "$ROW_OK" ]] || {
+echo "== fleet bench rows (in-process router + HTTP replicas) =="
+# each row runs in its OWN process with its OWN bounded retry: a
+# p99-recovery (or p99-ratio) clock on a loaded 2-core host gets one
+# fresh chance per row, and the tracing A/B measures a quiet process
+# instead of the failover row's thread aftermath
+bench_row() {  # bench_row <rows> <metric>
+    local rows="$1" metric="$2" attempt
+    for attempt in 1 2; do
+        if JAX_PLATFORMS=cpu PYTHONPATH="$ROOT" \
+            python -m keystone_tpu serve-bench --fleet-only \
+            --fleet-rows "$rows" \
+            --d "$D" --hidden "$D" --depth 2 --buckets 4,16 --no-cache \
+            | tee "$BENCH_LOG" \
+            && grep "\"metric\": \"$metric\"" "$BENCH_LOG" \
+                | grep -q '"verdict": "green"'; then
+            return 0
+        fi
+        echo "$metric attempt $attempt not green; $([ "$attempt" -lt 2 ] \
+            && echo 'retrying once (host-load flake guard)' \
+            || echo 'out of retries')"
+    done
+    return 1
+}
+bench_row failover serving_router_failover || {
     echo "FAIL: serving_router_failover red on both attempts"; exit 1; }
 echo "PASS serving_router_failover (verdict green, fleet p99 federated)"
+bench_row trace serving_router_trace_overhead || {
+    echo "FAIL: serving_router_trace_overhead red on both attempts"; exit 1; }
+echo "PASS serving_router_trace_overhead (tracing-on p99 <= 1.05x off)"
 
 # ---- 2. three-process fleet: router + 2 self-registering replicas --------
 echo "== three-process drill: router + 2 replicas =="
@@ -285,5 +304,83 @@ print("fleet p99 %.1fms agrees with per-replica %sms "
 ' "$ROUTER" "$R1" "$R2" || {
     echo "FAIL: federated quantile disagreed with per-replica quantiles"; exit 1; }
 echo "PASS SLO federation"
+
+# ---- 6. distributed tracing: one id, two processes, one stitched tree ----
+echo "== distributed tracing: cross-process stitch through the router =="
+PYTHONPATH="$ROOT" python -c '
+import json, sys, time, urllib.request
+
+router, r1, r2, d = sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4])
+
+body = json.dumps({"instances": [[0.25] * d]}).encode()
+req = urllib.request.Request(router + "/predict", data=body,
+                             headers={"Content-Type": "application/json"})
+t0 = time.perf_counter()
+with urllib.request.urlopen(req, timeout=60) as resp:
+    resp.read()
+    measured_ms = (time.perf_counter() - t0) * 1e3
+    tid = resp.headers.get("X-Keystone-Trace")
+assert tid, "/predict response carried no X-Keystone-Trace header"
+print(f"trace id {tid} (measured {measured_ms:.1f}ms)")
+time.sleep(0.5)  # replica stage spans finish just after the response
+
+def get_json(url):
+    with urllib.request.urlopen(url, timeout=15) as resp:
+        return json.loads(resp.read())
+
+# the id is visible in the router Tracer ring AND at least one replica
+rt = get_json(router + "/tracez")
+assert any(s["trace_id"] == tid for s in rt["spans"]), \
+    "router /tracez does not show the trace"
+replica_hits = [
+    url for url in (r1, r2)
+    if any(s["trace_id"] == tid
+           for s in get_json(url + "/tracez")["spans"])
+]
+assert replica_hits, "no replica /tracez shows the trace id"
+print(f"trace visible in router + {len(replica_hits)} replica /tracez")
+
+# the stitched tree: spans from both processes under ONE trace id
+doc = get_json(router + f"/debugz?trace_id={tid}")
+assert len(doc["processes"]) >= 2, (
+    "stitch is router-only: %s (partial_detail=%s)"
+    % (doc["processes"], doc["partial_detail"]))
+assert not doc["partial"], doc["partial_detail"]
+names = {s["name"] for s in doc["spans"]}
+assert "router.forward" in names and "gateway.admit" in names, names
+grafted = [s for s in doc["spans"] if s.get("grafted")]
+assert grafted, "no replica span was grafted under a router hop"
+
+# chrome render loads as one multi-process trace
+chrome = get_json(router + f"/debugz?trace_id={tid}&format=chrome")
+pids = {e["pid"] for e in chrome["traceEvents"] if e.get("ph") == "X"}
+assert len(pids) >= 2, f"chrome trace has one pid only: {pids}"
+
+# phase decomposition sums to within 10% of the measured request
+# latency (the router-measured total). The client clock only bounds
+# it from above: client-side connection setup on a loaded host is
+# NOT part of the server-side request.
+phases = doc["phases_ms"]
+total = doc["total_ms"]
+ph_sum = sum(phases.values())
+assert abs(ph_sum - total) <= 0.1 * total, (phases, total)
+assert total <= measured_ms + 1.0, (
+    f"stitched total {total}ms exceeds client-measured "
+    f"{measured_ms:.1f}ms")
+assert total >= 0.2 * measured_ms, (
+    f"stitched total {total}ms implausibly small vs client-measured "
+    f"{measured_ms:.1f}ms")
+print(f"phases {phases} sum {ph_sum:.1f}ms ~ total {total}ms "
+      f"(client measured {measured_ms:.1f}ms)")
+
+# the phase family rides the router/federated /metrics
+with urllib.request.urlopen(router + "/metrics", timeout=15) as resp:
+    fed = resp.read().decode()
+assert "keystone_request_phase_seconds_bucket" in fed, \
+    "keystone_request_phase_seconds missing from federated /metrics"
+print("keystone_request_phase_seconds present in federated /metrics")
+' "$ROUTER" "$R1" "$R2" "$D" || {
+    echo "FAIL: cross-process trace did not stitch"; exit 1; }
+echo "PASS distributed tracing (one trace id, stitched /debugz, phases sum)"
 
 echo "smoke-fleet: all checks passed"

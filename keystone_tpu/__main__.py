@@ -59,15 +59,55 @@ def main(argv=None) -> int:
                   "(e.g. http://127.0.0.1:4318)")
             return 2
         del argv[i : i + 2]
+
+        def peel_value(flag, default):
+            if flag not in argv:
+                return default
+            j = argv.index(flag)
+            try:
+                value = argv[j + 1]
+                if value.startswith("-"):
+                    raise ValueError(value)
+            except (IndexError, ValueError):
+                raise SystemExit(f"{flag} requires a value") from None
+            del argv[j : j + 2]
+            return value
+
+        import os
+        import socket
+
+        # resource identity: which SERVICE (router vs gateway vs app)
+        # and which REPLICA this process is — what lets an external
+        # collector lay the fleet's halves of one trace out as the
+        # same topology the router's stitched /debugz shows. The app
+        # name is a sensible service default; cross-host fleets pass
+        # --otlp-replica the advertised host:port.
+        default_service = (
+            f"keystone-{argv[0].removeprefix('serve-')}"
+            if argv and not argv[0].startswith("-")
+            else "keystone-tpu"
+        )
+        service = peel_value("--otlp-service", default_service)
+        replica = peel_value(
+            "--otlp-replica", f"{socket.gethostname()}:{os.getpid()}"
+        )
         from keystone_tpu.observability import (
             OtlpSpanExporter,
             enable_tracing,
         )
 
         enable_tracing()
-        exporter = OtlpSpanExporter(endpoint)
+        exporter = OtlpSpanExporter(
+            endpoint,
+            service_name=service,
+            resource_attrs={"replica": replica},
+        )
         exporter.install()
-        print(f"otlp export: {exporter.endpoint}", flush=True)
+        print(
+            f"otlp export: {exporter.endpoint} "
+            f"(service.name={service} replica={replica})",
+            flush=True,
+        )
     gateway_port = None
     if "--gateway-port" in argv:
         # request plane: admission control + replica lanes + live
@@ -170,7 +210,14 @@ def main(argv=None) -> int:
               " collector (POST")
         print("                   URL/v1/traces, background batching,"
               " stdlib-only). Implies")
-        print("                   tracing on. Off by default.")
+        print("                   tracing on. Off by default."
+              " --otlp-service NAME and")
+        print("                   --otlp-replica HOST:PORT stamp the"
+              " service.name/replica")
+        print("                   resource attrs (defaults: the app"
+              " name, hostname:pid) so an")
+        print("                   external collector sees the fleet's"
+              " stitched topology.")
         return 0 if argv else 2
     app = argv[0]
     if app == "serve-bench":

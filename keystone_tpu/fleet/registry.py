@@ -303,6 +303,17 @@ class ReplicaRegistry:
         with self._lock:
             return list(self._replicas.values())
 
+    def find_by_name(self, name: str) -> Optional[Replica]:
+        """Replica by roster name (``host:port``) — how the trace
+        stitcher resolves a ``router.forward`` span's ``replica`` attr
+        back to a URL it is allowed to dial (the registry is the
+        authority on fleet membership, not span attrs)."""
+        with self._lock:
+            for replica in self._replicas.values():
+                if replica.name == name:
+                    return replica
+        return None
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._replicas)

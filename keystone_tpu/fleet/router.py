@@ -40,15 +40,39 @@ gateway frontend (``observability/httpd.py``). Routes:
 - ``GET /slz`` — burn rates of the router's fleet-wide latency SLO
   (``Slo.latency_from_buckets`` over the merged replica buckets) when
   one is declared, alongside any replica-local monitors in-process.
+- ``GET /tracez`` — this process's recent spans (one ``router.forward``
+  span per forward attempt; retries are sibling spans with a
+  ``retry_reason`` attr), same surface as the gateway's.
+- ``GET /debugz?trace_id=`` — **stitched cross-process forensics**
+  (``observability/stitch.py``): the router's spans for the trace plus
+  each involved replica's ``/debugz`` half grafted under the
+  router-hop spans, rendered as JSON (with the
+  ``router_hop/queue_wait/coalesce/device/deliver`` phase
+  decomposition) or one multi-process Chrome trace
+  (``format=chrome``). Partial when a replica can't contribute —
+  counted, never an error.
+
 - ``GET /readyz`` — 200 while at least one replica is ready+healthy
   (the roster state rides in the body), 503 otherwise: the router is
   a routing signal for the layer above it, same contract as the
   gateway's.
 - ``GET|POST /chaosz`` — the fault-injection plane, identical to the
-  gateway frontend's: the fleet-level point
+  gateway frontend's: the fleet-level points
   ``router.replica.blackhole`` (drop a matched replica's /predict
-  responses — a return-path partition) is armed HERE, in the router
-  process, and fires on the forward path.
+  responses — a return-path partition) and ``router.trace.drop``
+  (strip the traceparent off a forward — the partial-stitch drill)
+  are armed HERE, in the router process, and fire on the forward
+  path.
+
+Distributed tracing rides the hot path: the router mints (or adopts
+an inbound) W3C ``traceparent``, sends it on every forward so the
+replica's whole admit → coalesce → dispatch chain shares the trace
+id, and echoes ``X-Keystone-Trace`` on every /predict response —
+success AND typed shed. ``--request-log`` writes the gateway's
+replayable JSONL schema plus ``replica``/``attempts`` per routed
+POST. Tracing is ON by default (``--no-trace`` opts out); the
+``serving_router_trace_overhead`` bench row bounds its cost at
+<= 1.05x p99.
 """
 
 from __future__ import annotations
@@ -60,14 +84,29 @@ import time
 import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional, Sequence, Tuple
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 from keystone_tpu.fleet.registry import ReplicaRegistry
 from keystone_tpu.loadgen import faults
 from keystone_tpu.observability import prometheus
 from keystone_tpu.observability import slo as slo_mod
-from keystone_tpu.observability.httpd import BackgroundServer, JsonHandler
+from keystone_tpu.observability.httpd import (
+    BackgroundServer,
+    JsonHandler,
+    RequestLogWriter,
+    next_post_seq,
+)
 from keystone_tpu.observability.registry import get_global_registry
+from keystone_tpu.observability.stitch import TraceStitcher
+from keystone_tpu.observability.tracing import (
+    TRACEPARENT_HEADER,
+    TRACE_RESPONSE_HEADER,
+    format_traceparent,
+    get_tracer,
+    new_trace_id,
+    parse_traceparent,
+    tracez_document,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -153,6 +192,17 @@ class RouterMetrics:
 
 
 class _RouterHandler(JsonHandler):
+    def _send(self, code, body, content_type, headers=None) -> None:
+        # every /predict response — forwarded success, propagated
+        # typed shed, router-minted shed — echoes the ONE fleet-wide
+        # trace id; even when the replica answered under a different
+        # (self-minted) id, the ROUTER's id is the one its /debugz
+        # can stitch, partially or fully
+        tid = getattr(self, "_trace_id", None)
+        if tid:
+            headers = {**(headers or {}), TRACE_RESPONSE_HEADER: tid}
+        super()._send(code, body, content_type, headers=headers)
+
     def _send_error_json(self, code: int, error: str, **extra) -> None:
         self._send_json({"error": error, **extra}, code=code)
 
@@ -165,7 +215,9 @@ class _RouterHandler(JsonHandler):
         return self.server.metrics  # type: ignore[attr-defined]
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib handler API)
-        path = urlparse(self.path).path
+        url = urlparse(self.path)
+        path = url.path
+        self._trace_id = None  # per-request (keep-alive safety)
         try:
             if path == "/readyz":
                 counts = self.fleet.counts()
@@ -192,6 +244,28 @@ class _RouterHandler(JsonHandler):
                 )
             elif path == "/slz":
                 self._send_json(slo_mod.slz_status(), indent=1)
+            elif path == "/tracez":
+                q = parse_qs(url.query)
+                self._send_json(
+                    tracez_document(
+                        get_tracer(),
+                        q.get("format", [""])[0],
+                        q["n"][0] if "n" in q else None,
+                    ),
+                    indent=1,
+                )
+            elif path == "/debugz":
+                # the stitched cross-process forensics: this router's
+                # router.forward spans + every involved replica's
+                # /debugz half, grafted into one tree with the phase
+                # decomposition (observability/stitch.py)
+                q = parse_qs(url.query)
+                code, doc = self.server.stitcher.document(  # type: ignore[attr-defined]
+                    q.get("trace_id", [None])[0],
+                    q.get("format", [""])[0],
+                    self.server.resolve_replica_url,  # type: ignore[attr-defined]
+                )
+                self._send_json(doc, code=code, indent=1)
             elif path == "/chaosz":
                 if not self.server.chaos_routes:  # type: ignore[attr-defined]
                     self._send_error_json(
@@ -206,7 +280,8 @@ class _RouterHandler(JsonHandler):
                 self._send_text(
                     404,
                     "not found; try /predict /registerz /fleetz "
-                    "/readyz /healthz /metrics /slz /chaosz\n",
+                    "/readyz /healthz /metrics /slz /tracez /debugz "
+                    "/chaosz\n",
                 )
         except Exception as e:
             logger.exception("router GET error for %s", self.path)
@@ -214,6 +289,7 @@ class _RouterHandler(JsonHandler):
 
     def do_POST(self) -> None:  # noqa: N802 (stdlib handler API)
         path = urlparse(self.path).path
+        self._trace_id = None  # _predict adopts/mints; see _send
         try:
             if path == "/predict":
                 self._predict()
@@ -235,9 +311,77 @@ class _RouterHandler(JsonHandler):
 
     # -- the fleet hot path -------------------------------------------------
 
+    def _log_request(
+        self,
+        status: int,
+        latency_s: float,
+        attempts: int,
+        replica_name: Optional[str],
+        body: bytes,
+        error: Optional[str] = None,
+    ) -> None:
+        """One structured JSON line per routed POST (``--request-log``)
+        — the GATEWAY's schema (``ts/path/status/latency_ms/lane/
+        trace_id/n_rows/shape/deadline_ms/post_seq``) plus the fleet
+        fields ``replica`` (who served it) and ``attempts``, so a
+        fleet recording replays through the same ``loadgen/trace.py``
+        parser as a single-gateway one."""
+        n_rows = shape = deadline_ms = None
+        try:
+            doc = json.loads(body or b"{}")
+            instances = doc.get("instances")
+            if isinstance(instances, list) and instances:
+                n_rows = len(instances)
+                first, dims = instances[0], []
+                while isinstance(first, list):
+                    dims.append(len(first))
+                    first = first[0] if first else None
+                shape = dims
+            deadline_ms = doc.get("deadline_ms")
+        except (ValueError, TypeError):
+            pass  # a malformed body still deserves its outcome line
+        line = {
+            "ts": round(self._t_wall, 6),
+            "path": "/predict",
+            "status": status,
+            "latency_ms": round(latency_s * 1e3, 3),
+            "lane": None,  # schema parity: lanes are a replica detail
+            "trace_id": self._trace_id,
+            "n_rows": n_rows,
+            "shape": shape,
+            "deadline_ms": deadline_ms,
+            "post_seq": next_post_seq(),
+            "replica": replica_name,
+            "attempts": attempts,
+        }
+        if error is not None:
+            line["error"] = error
+        self.server.write_request_log(line)  # type: ignore[attr-defined]
+
     def _predict(self) -> None:
         body = self._read_body()
+        t0 = time.perf_counter()
+        self._t_wall = time.time()  # arrival clock for the request log
+        # one fleet-wide trace id per request: adopt the client's W3C
+        # traceparent if it sent one, mint otherwise (tracing on) —
+        # every forward attempt below is a SIBLING span under this id
+        # and the header the replica receives carries it downstream
+        tracer = get_tracer()
+        ctx = parse_traceparent(self.headers.get(TRACEPARENT_HEADER))
+        if ctx is not None:
+            self._trace_id = ctx.trace_id
+        elif tracer.enabled:
+            self._trace_id = new_trace_id()
+        request_log = self.server.request_log  # type: ignore[attr-defined]
         if not body:
+            if request_log:
+                # one line per routed POST means THIS one too — a
+                # replay that silently loses client mistakes can't
+                # reproduce the client's offered load
+                self._log_request(
+                    400, time.perf_counter() - t0, 0, None, body,
+                    error="empty /predict body",
+                )
             self._send_error_json(
                 400, "bad_request", detail="empty /predict body"
             )
@@ -246,6 +390,7 @@ class _RouterHandler(JsonHandler):
         tried: List = []
         typed_fallback: Optional[Tuple[int, bytes]] = None
         untyped_fallback: Optional[Tuple[int, bytes]] = None
+        retry_reason: Optional[str] = None
         for _attempt in range(max_retries + 1):
             replica = self.fleet.pick(exclude=tried)
             if replica is None:
@@ -255,9 +400,48 @@ class _RouterHandler(JsonHandler):
                 # counted HERE, when a second attempt actually
                 # dispatches — an exhausted pick() is not a retry
                 self.metrics.record_retry()
+            # one router.forward span per ATTEMPT: retries are sibling
+            # spans (same trace, no parent) whose retry_reason attr
+            # says why the previous hop failed — the stitched tree
+            # shows the failover, not just the attempt that won
+            span = tracer.start_span(
+                "router.forward",
+                trace_id=self._trace_id,
+                router=self.server.router_name,  # type: ignore[attr-defined]
+                replica=replica.name,
+                attempt=_attempt,
+            )
+            if retry_reason is not None:
+                span.set_attr("retry_reason", retry_reason)
+            traceparent = None
+            if self._trace_id is not None:
+                # tracing off but an inbound context present: relay
+                # the caller's header verbatim (a formatted one would
+                # carry the null span's all-zero parent id, which the
+                # replica must reject per the W3C spec)
+                traceparent = (
+                    format_traceparent(self._trace_id, span.span_id)
+                    if span.span_id is not None
+                    else self.headers.get(TRACEPARENT_HEADER)
+                )
+                # chaos point: strip the trace context off this
+                # forward (router.trace.drop) — the replica must fall
+                # back to a self-minted id and serve normally, and
+                # the stitch must degrade to a counted partial tree
+                if faults.armed() and faults.fire(
+                    "router.trace.drop",
+                    {"replica": replica.name, "index": replica.index},
+                ) is not None:
+                    span.set_attr("traceparent_dropped", True)
+                    traceparent = None
             try:
-                status, payload, ctype = self._forward(replica, body)
+                status, payload, ctype = self._forward(
+                    replica, body, traceparent
+                )
+                span.set_attr("status", status)
             except ReplicaUnavailable as e:
+                retry_reason = f"{replica.name}: {e}"
+                span.set_attr("error", str(e))
                 if e.charge:
                     replica.mark_failed(str(e))
                 if e.typed is not None:
@@ -271,12 +455,38 @@ class _RouterHandler(JsonHandler):
                         replica.name, e,
                     )
                 continue
+            except Exception as e:
+                # transport-layer surprises urllib does NOT wrap as
+                # OSError (http.client.BadStatusLine, IncompleteRead,
+                # ...) propagate to do_POST's 500 handler — but the
+                # attempt span must still record (or the forensics
+                # for exactly the failed request lose its forward
+                # hop), and the request log still gets its
+                # one-line-per-POST outcome
+                span.set_attr("error", f"{type(e).__name__}: {e}")
+                if request_log:
+                    self._log_request(
+                        500, time.perf_counter() - t0, len(tried),
+                        replica.name, body,
+                        error=f"{type(e).__name__}: {e}",
+                    )
+                raise
+            finally:
+                # every exit path — success, retry, raise — ends the
+                # span: a leaked _ActiveSpan stays on this handler
+                # thread's stack and never reaches the ring/exporter
+                tracer.end_span(span)
             replica.mark_ok()
             self.metrics.record_outcome(
                 "ok" if status < 400
                 else "shed" if status in (429, 503, 504)
                 else "error"
             )
+            if request_log:
+                self._log_request(
+                    status, time.perf_counter() - t0, len(tried),
+                    replica.name, body,
+                )
             self._send(
                 status, payload,
                 ctype or "application/json; charset=utf-8",
@@ -290,6 +500,11 @@ class _RouterHandler(JsonHandler):
             # invariant checker built to catch exactly that.
             status, payload = untyped_fallback
             self.metrics.record_outcome("error")
+            if request_log:
+                self._log_request(
+                    status, time.perf_counter() - t0, len(tried),
+                    None, body, error=retry_reason,
+                )
             self._send(
                 status, payload, "application/json; charset=utf-8"
             )
@@ -299,11 +514,21 @@ class _RouterHandler(JsonHandler):
             # answer (503 closed), not a router-invented error
             status, payload = typed_fallback
             self.metrics.record_outcome("shed")
+            if request_log:
+                self._log_request(
+                    status, time.perf_counter() - t0, len(tried),
+                    None, body, error="closed",
+                )
             self._send(
                 status, payload, "application/json; charset=utf-8"
             )
             return
         self.metrics.record_outcome("shed")
+        if request_log:
+            self._log_request(
+                503, time.perf_counter() - t0, len(tried), None, body,
+                error=retry_reason or "no replica available",
+            )
         self._send_json(
             {
                 "error": "overloaded",
@@ -316,15 +541,25 @@ class _RouterHandler(JsonHandler):
             code=503,
         )
 
-    def _forward(self, replica, body: bytes) -> Tuple[int, bytes, str]:
-        """POST the raw /predict body to one replica. Returns
-        ``(status, payload, content_type)`` for any response the
-        client should see verbatim; raises ``ReplicaUnavailable`` for
-        outcomes worth trying another replica for."""
+    def _forward(
+        self,
+        replica,
+        body: bytes,
+        traceparent: Optional[str] = None,
+    ) -> Tuple[int, bytes, str]:
+        """POST the raw /predict body to one replica (plus the W3C
+        ``traceparent`` when the request is traced — the replica
+        adopts its trace id). Returns ``(status, payload,
+        content_type)`` for any response the client should see
+        verbatim; raises ``ReplicaUnavailable`` for outcomes worth
+        trying another replica for."""
+        headers = {"Content-Type": "application/json"}
+        if traceparent is not None:
+            headers[TRACEPARENT_HEADER] = traceparent
         req = urllib.request.Request(
             replica.url + "/predict",
             data=body,
-            headers={"Content-Type": "application/json"},
+            headers=headers,
             method="POST",
         )
         timeout = self.server.forward_timeout_s  # type: ignore[attr-defined]
@@ -501,6 +736,8 @@ class RouterServer(BackgroundServer):
         forward_timeout_s: float = FORWARD_TIMEOUT_S,
         max_retries: int = 1,
         chaos_routes: bool = True,
+        request_log: Any = False,
+        stitch_timeout_s: float = 5.0,
         slo_latency_s: Optional[float] = None,
         slo_target: float = 0.99,
         slo_fast_window_s: float = 60.0,
@@ -517,6 +754,17 @@ class RouterServer(BackgroundServer):
             registry if registry is not None else get_global_registry()
         )
         self.metrics = RouterMetrics(registry=self.registry, router=name)
+        # ``--request-log`` parity with the gateway: one JSON line per
+        # routed POST in the same replayable schema (plus replica +
+        # attempts), through the shared writer
+        self._request_log = RequestLogWriter(request_log)
+        self.request_log = self._request_log.enabled
+        # the cross-process forensics engine behind GET /debugz
+        self.stitcher = TraceStitcher(
+            name=name,
+            registry=self.registry,
+            fetch_timeout_s=stitch_timeout_s,
+        )
         kwargs: Dict[str, Any] = {}
         if unhealthy_after is not None:
             kwargs["unhealthy_after"] = unhealthy_after
@@ -596,6 +844,16 @@ class RouterServer(BackgroundServer):
 
     # -- lifecycle ----------------------------------------------------------
 
+    def resolve_replica_url(self, name: str) -> Optional[str]:
+        """Replica NAME (a ``router.forward`` span's ``replica`` attr)
+        -> base URL via the registry — the stitcher only ever dials
+        replicas the fleet actually knows, never a URL a span claims."""
+        replica = self.fleet.find_by_name(name)
+        return replica.url if replica is not None else None
+
+    def write_request_log(self, line: Dict[str, Any]) -> None:
+        self._request_log.write(line)
+
     def _configure(self, httpd) -> None:
         httpd.fleet = self.fleet
         httpd.metrics = self.metrics
@@ -604,6 +862,11 @@ class RouterServer(BackgroundServer):
         httpd.chaos_routes = self.chaos_routes
         httpd.federated_metrics = self.federated_metrics
         httpd.fleetz = self.fleetz
+        httpd.router_name = self.name
+        httpd.request_log = self.request_log
+        httpd.write_request_log = self.write_request_log
+        httpd.stitcher = self.stitcher
+        httpd.resolve_replica_url = self.resolve_replica_url
 
     def start(self) -> "RouterServer":
         super().start()
@@ -617,6 +880,7 @@ class RouterServer(BackgroundServer):
             self.slo_monitor.stop()
         self.fleet.stop()
         super().stop()
+        self._request_log.close()
 
 
 def main(argv=None) -> int:
@@ -661,7 +925,27 @@ def main(argv=None) -> int:
     ap.add_argument("--no-chaosz", action="store_true",
                     help="disable the /chaosz fault-injection routes "
                     "on this router")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="disable distributed tracing: no "
+                    "router.forward spans, no W3C traceparent "
+                    "propagation to replicas, no X-Keystone-Trace "
+                    "echo, no /debugz stitching (default ON — the "
+                    "serving_router_trace_overhead bench row bounds "
+                    "the cost at <= 1.05x p99)")
+    ap.add_argument("--request-log", nargs="?", const=True,
+                    default=False, metavar="FILE",
+                    help="one structured JSON line per routed "
+                    "/predict (the gateway's replayable schema plus "
+                    "replica + attempts). Bare flag: stdout; with "
+                    "FILE: append line-buffered JSONL there")
     args = ap.parse_args(argv)
+    if not args.no_trace:
+        # the fleet's forensic chain — traceparent propagation, the
+        # stitched /debugz, phase decomposition — keys off spans, so
+        # the router traces by default
+        from keystone_tpu.observability import enable_tracing
+
+        enable_tracing()
     server = RouterServer(
         args.replica,
         port=args.port,
@@ -673,6 +957,7 @@ def main(argv=None) -> int:
         forward_timeout_s=args.forward_timeout,
         max_retries=args.max_retries,
         chaos_routes=not args.no_chaosz,
+        request_log=args.request_log,
         slo_latency_s=(
             args.slo_latency_ms / 1e3
             if args.slo_latency_ms is not None else None
@@ -699,7 +984,7 @@ def main(argv=None) -> int:
     print(
         f"router: {server.url()} (POST /predict, POST /registerz, "
         "GET /fleetz, GET /readyz, GET /metrics, GET /slz, "
-        "GET|POST /chaosz)",
+        "GET /tracez, GET /debugz?trace_id=, GET|POST /chaosz)",
         flush=True,
     )
     stop = threading.Event()

@@ -200,16 +200,23 @@ class AdmissionController:
         return pending / rate
 
     def submit(
-        self, example: Any, deadline_ms: Optional[float] = None
+        self,
+        example: Any,
+        deadline_ms: Optional[float] = None,
+        trace_id: Optional[str] = None,
     ) -> Future:
         """Admit one example or raise ``Overloaded``. The returned
         future resolves with the example's pipeline output (or the
-        terminal error after any lane retry)."""
+        terminal error after any lane retry). ``trace_id`` adopts a
+        remote trace identity (the HTTP frontend's parsed W3C
+        ``traceparent``) so the whole admit → coalesce → dispatch
+        chain, the latency exemplar, and any flight-recorder capture
+        ride the CALLER's trace — one id across the fleet hop."""
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
         deadline_s = deadline_ms / 1e3 if deadline_ms is not None else None
         with get_tracer().span(
-            "gateway.admit", gateway=self.name
+            "gateway.admit", trace_id=trace_id, gateway=self.name
         ) as span:
             with self._cond:
                 if not self._accepting:
@@ -244,7 +251,11 @@ class AdmissionController:
                         t + deadline_s if deadline_s is not None else None
                     ),
                     parent_span_id=span.span_id,
-                    trace_id=getattr(span, "trace_id", None),
+                    # the adopted id survives even with tracing off
+                    # (null span): the request log / exemplars / the
+                    # X-Keystone-Trace echo still correlate with the
+                    # router's trace
+                    trace_id=getattr(span, "trace_id", None) or trace_id,
                 )
                 # ride the identity on the future so the HTTP frontend
                 # can log a greppable trace_id per request

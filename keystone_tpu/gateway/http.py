@@ -10,7 +10,13 @@ A stdlib ``http.server`` on a background daemon thread, following the
   individually, so concurrent clients coalesce in the micro-batchers.
   Responds ``{"predictions": [...]}``; typed errors map to status
   codes: 429 shed (``Overloaded``: queue_full/deadline), 504 expired,
-  503 draining/closed, 400 malformed, 500 engine error.
+  503 draining/closed, 400 malformed, 500 engine error. An inbound
+  W3C ``traceparent`` header (the fleet router sends one per forward)
+  is ADOPTED: every instance's admit → coalesce → dispatch span
+  chain, the latency exemplars, and any flight-recorder capture ride
+  the caller's trace id, and every response — success AND typed
+  shed — echoes it as ``X-Keystone-Trace`` (with tracing on and no
+  inbound context, this process roots the trace itself).
 - ``GET /readyz`` — 200 while the gateway admits, 503 once draining.
   READINESS, not liveness: the admin endpoint's ``/healthz`` answers
   "is the process up", this answers "should the load balancer route
@@ -67,7 +73,6 @@ needs no process-output scraping.
 
 from __future__ import annotations
 
-import itertools
 import json
 import logging
 import threading
@@ -85,28 +90,26 @@ from keystone_tpu.observability import flight as flight_mod
 from keystone_tpu.observability import profilez as profilez_mod
 from keystone_tpu.observability import prometheus
 from keystone_tpu.observability import slo as slo_mod
-from keystone_tpu.observability.httpd import BackgroundServer, JsonHandler
+from keystone_tpu.observability.httpd import (
+    BackgroundServer,
+    JsonHandler,
+    RequestLogWriter,
+    next_post_seq,
+)
 from keystone_tpu.observability.registry import get_global_registry
+from keystone_tpu.observability.tracing import (
+    TRACEPARENT_HEADER,
+    TRACE_RESPONSE_HEADER,
+    get_tracer,
+    new_trace_id,
+    parse_traceparent,
+)
 
 logger = logging.getLogger(__name__)
 
 # generous server-side ceiling for waiting on one prediction; requests
 # with their own deadline wait deadline + slack instead
 RESULT_TIMEOUT_S = 60.0
-
-# per-POST identity for the request log: concurrent handler threads
-# interleave their per-instance lines, so a replayer can't rely on
-# adjacency — lines from one POST share a post_seq instead
-# (next() on itertools.count is atomic under the GIL). The random
-# per-process prefix keeps ids unique across restarts: request logs
-# open in APPEND mode, and a counter restarting at 1 would make a
-# second session's posts dedupe away against the first's.
-_POST_NONCE = "%08x" % __import__("random").getrandbits(32)
-_POST_SEQ = itertools.count(1)
-
-
-def _next_post_seq() -> str:
-    return f"{_POST_NONCE}-{next(_POST_SEQ)}"
 
 
 def _status_for(err: Overloaded) -> int:
@@ -118,6 +121,15 @@ def _status_for(err: Overloaded) -> int:
 
 
 class _Handler(JsonHandler):
+    def _send(self, code, body, content_type, headers=None) -> None:
+        # every response of a traced /predict (success, typed shed,
+        # error) carries the trace id — the client's forensic handle
+        # into /debugz?trace_id= on whichever process served it
+        tid = getattr(self, "_trace_id", None)
+        if tid:
+            headers = {**(headers or {}), TRACE_RESPONSE_HEADER: tid}
+        super()._send(code, body, content_type, headers=headers)
+
     def _send_error_json(self, code: int, error: str, **extra) -> None:
         self._send_json({"error": error, **extra}, code=code)
 
@@ -128,6 +140,7 @@ class _Handler(JsonHandler):
     def do_GET(self) -> None:  # noqa: N802 (stdlib handler API)
         url = urlparse(self.path)
         path = url.path
+        self._trace_id = None  # per-request (keep-alive safety)
         try:
             if path == "/readyz":
                 # the load-report header: queued + in-lane requests,
@@ -197,7 +210,6 @@ class _Handler(JsonHandler):
                     )
             elif path == "/tracez":
                 from keystone_tpu.observability.tracing import (
-                    get_tracer,
                     tracez_document,
                 )
 
@@ -263,6 +275,7 @@ class _Handler(JsonHandler):
 
     def do_POST(self) -> None:  # noqa: N802 (stdlib handler API)
         path = urlparse(self.path).path
+        self._trace_id = None  # _predict adopts/mints; see _send
         self._t_post = time.perf_counter()
         # ARRIVAL wall time: request-log lines stamp this (not
         # log-emit time, which for success lines is after the whole
@@ -373,6 +386,17 @@ class _Handler(JsonHandler):
         self._send_json(injector.status(), indent=1)
 
     def _predict(self) -> None:
+        # W3C trace adoption FIRST, before the body can 400 or
+        # admission can shed: the router (or any tracing caller) sent
+        # a `traceparent`, and EVERY response — success, typed shed,
+        # malformed body — must echo the one trace id the fleet knows
+        # this request by. With no inbound context and tracing on,
+        # this process roots the trace itself (single-gateway mode).
+        ctx = parse_traceparent(self.headers.get(TRACEPARENT_HEADER))
+        if ctx is not None:
+            self._trace_id = ctx.trace_id
+        elif get_tracer().enabled:
+            self._trace_id = new_trace_id()
         try:
             doc = json.loads(self._read_body() or b"{}")
             instances = doc["instances"]
@@ -406,15 +430,22 @@ class _Handler(JsonHandler):
             "n_rows": len(examples),
             "shape": list(examples[0].shape),
             "deadline_ms": deadline_ms,
-            "post_seq": _next_post_seq(),
+            "post_seq": next_post_seq(),
         }
         # admit every instance BEFORE waiting on any: concurrent
-        # instances coalesce into shared micro-batch windows
+        # instances coalesce into shared micro-batch windows. Every
+        # instance of one POST shares the POST's trace id — the span
+        # trees of sibling instances interleave under one trace, which
+        # is what the router's cross-process stitch joins on.
         futures = []
         try:
             for ex in examples:
                 futures.append(
-                    self.gateway.predict(ex, deadline_ms=deadline_ms)
+                    self.gateway.predict(
+                        ex,
+                        deadline_ms=deadline_ms,
+                        trace_id=self._trace_id,
+                    )
                 )
         except Overloaded:
             # partial admission on a shed response: cancel what was
@@ -497,21 +528,12 @@ class GatewayServer(BackgroundServer, device_obs.MemorySamplerHost):
             registry if registry is not None else get_global_registry()
         )
         self.input_dtype = np.dtype(input_dtype)
-        self.request_log = bool(request_log)
+        # the line-at-a-time sink (stdout or JSONL file) now lives in
+        # observability/httpd.py — shared with the fleet router so
+        # both tiers log the same replayable schema
+        self._request_log = RequestLogWriter(request_log)
+        self.request_log = self._request_log.enabled
         self.chaos_routes = bool(chaos_routes)
-        # the stop() close race (PR 7 review): a straggler handler
-        # thread must re-check this under the lock, never write to a
-        # closed file — the guarded-by rule keeps it that way
-        self._request_log_file = None  # guarded-by: _request_log_lock
-        self._request_log_lock = threading.Lock()
-        self._log_to_file = isinstance(request_log, (str, bytes)) or hasattr(
-            request_log, "__fspath__"
-        )
-        if self._log_to_file:
-            self._request_log_file = open(  # noqa: SIM115 (held open
-                # for the server's lifetime; stop() closes it)
-                request_log, "a", buffering=1, encoding="utf-8",
-            )
         # single-port deployments scrape THIS port: carry the device
         # identity gauge and the memory sampler here too, same as the
         # admin endpoint (refcounted — one thread per registry even
@@ -527,19 +549,8 @@ class GatewayServer(BackgroundServer, device_obs.MemorySamplerHost):
         httpd.write_request_log = self.write_request_log
 
     def write_request_log(self, line: dict) -> None:
-        """One record to the request log (stdout or the file). Handler
-        threads are concurrent; the lock keeps lines whole."""
-        text = json.dumps(line)
-        if not self._log_to_file:
-            print(text, flush=True)
-            return
-        with self._request_log_lock:
-            # re-read under the lock: daemon handler threads are not
-            # joined by stop(), so a straggler can race the close —
-            # it must drop its line, not write to a closed file
-            out = self._request_log_file
-            if out is not None:
-                out.write(text + "\n")
+        """One record to the request log (stdout or the file)."""
+        self._request_log.write(line)
 
     def start(self) -> "GatewayServer":
         super().start()
@@ -549,10 +560,7 @@ class GatewayServer(BackgroundServer, device_obs.MemorySamplerHost):
     def stop(self) -> None:
         self._stop_memory_sampler()
         super().stop()
-        if self._request_log_file is not None:
-            with self._request_log_lock:
-                self._request_log_file.close()
-                self._request_log_file = None
+        self._request_log.close()
 
 
 def register_with_router(
@@ -630,6 +638,13 @@ def main(argv=None) -> int:
                     "tightening under sustained fast-window burn, and "
                     "tail-sampled forensics at /debugz (enables span "
                     "tracing)")
+    ap.add_argument("--trace", action="store_true",
+                    help="enable span tracing without declaring an "
+                    "SLO: /tracez fills, inbound W3C traceparent "
+                    "headers are adopted, and every /predict response "
+                    "carries X-Keystone-Trace — what a replica behind "
+                    "a tracing serve-router needs for cross-process "
+                    "stitching")
     ap.add_argument("--slo-target", type=float, default=0.99,
                     help="fraction of requests that must make the "
                     "latency threshold")
@@ -683,9 +698,10 @@ def main(argv=None) -> int:
 
         setup_aot_cache(args.aot_cache)
 
-    if args.slo_latency_ms is not None:
+    if args.slo_latency_ms is not None or args.trace:
         # the forensic chain (exemplars, flight records, burn gauges)
-        # keys off trace ids, so SLO mode implies tracing
+        # keys off trace ids, so SLO mode implies tracing; --trace
+        # turns the span plane on without an SLO (fleet stitching)
         from keystone_tpu.observability import enable_tracing
 
         enable_tracing()

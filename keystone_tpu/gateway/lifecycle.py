@@ -265,11 +265,17 @@ class Gateway:
     # -- serving -----------------------------------------------------------
 
     def predict(
-        self, example: Any, deadline_ms: Optional[float] = None
+        self,
+        example: Any,
+        deadline_ms: Optional[float] = None,
+        trace_id: Optional[str] = None,
     ) -> Future:
         """Admit one example; resolves to its pipeline output. Raises
-        ``Overloaded`` immediately when shed."""
-        return self.admission.submit(example, deadline_ms=deadline_ms)
+        ``Overloaded`` immediately when shed. ``trace_id`` adopts a
+        remote trace identity (see ``AdmissionController.submit``)."""
+        return self.admission.submit(
+            example, deadline_ms=deadline_ms, trace_id=trace_id
+        )
 
     @property
     def ready(self) -> bool:

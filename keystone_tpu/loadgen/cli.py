@@ -266,10 +266,48 @@ def main(argv: Optional[List[str]] = None) -> int:
         verdict.stats.setdefault("injections", {})[point] = fired
     doc = verdict.to_json(indent=1)
     print(doc, flush=True)
+    if not verdict.passed and args.target:
+        # a red verdict names its exemplar requests; print each known
+        # trace id as a ready-to-curl /debugz URL — against a fleet
+        # router that is the STITCHED cross-process tree with the
+        # phase decomposition, against a lone gateway the flight
+        # record / live span tree
+        _print_forensic_urls(
+            args.target, verdict.stats.get("exemplars") or {}
+        )
     if args.report:
         with open(args.report, "w", encoding="utf-8") as f:
             f.write(doc + "\n")
     return 0 if verdict.passed else 1
+
+
+def _print_forensic_urls(base_url: str, exemplars: dict) -> None:
+    base = base_url.rstrip("/")
+    entries = []
+    worst = exemplars.get("worst_latency")
+    if worst is not None:
+        entries.append(("worst-latency", worst))
+    entries.extend(("lost", e) for e in exemplars.get("lost", ()))
+    entries.extend(("untyped", e) for e in exemplars.get("untyped", ()))
+    seen = set()
+    for kind, e in entries:
+        tid = e.get("trace_id")
+        label = f"{kind} (request #{e.get('index')})"
+        if not tid:
+            print(
+                f"forensics: {label}: no trace id "
+                "(no response reached the client)",
+                flush=True,
+            )
+            continue
+        if tid in seen:
+            continue
+        seen.add(tid)
+        print(
+            f"forensics: {label}: "
+            f"curl '{base}/debugz?trace_id={tid}'",
+            flush=True,
+        )
 
 
 if __name__ == "__main__":

@@ -91,6 +91,15 @@ FAULT_POINTS: Dict[str, str] = {
         "absorb it (match: replica=<host:port> or index=<registration "
         "order>)"
     ),
+    "router.trace.drop": (
+        "drop @ fleet/router.py _predict — the W3C traceparent "
+        "header is stripped off the matched forward, so the replica "
+        "never sees the router's trace id and mints its own; serving "
+        "must be unaffected and the router's /debugz stitch must "
+        "degrade to a partial router-side tree counted on "
+        "keystone_trace_stitch_partial_total (match: "
+        "replica=<host:port> or index=<registration order>)"
+    ),
 }
 
 # points whose semantics are "arming IS the event" (no inline call

@@ -108,11 +108,48 @@ class InvariantChecker:
             results.append(self._p99_bound(report))
         stats = report.stats()
         stats.update(self._recovery_stats(report))
+        stats["exemplars"] = self._exemplars(report)
         return Verdict(
             passed=all(r.passed for r in results),
             invariants=results,
             stats=stats,
         )
+
+    # -- forensic exemplars --------------------------------------------------
+
+    @staticmethod
+    def _exemplars(report: LoadReport, limit: int = 16) -> Dict[str, Any]:
+        """Trace ids a human (or the CLI) can chase into
+        ``/debugz?trace_id=``: the worst-latency success plus every
+        lost and untyped request (capped) — a red verdict names the
+        exact requests that broke it, not just counts. Lost requests
+        usually have no trace id (no response came back); they are
+        listed anyway so the verdict shows what IS unattributable."""
+
+        def entry(r) -> Dict[str, Any]:
+            return {
+                "index": r.index,
+                "trace_id": r.trace_id,
+                "latency_ms": (
+                    round(r.latency_s * 1e3, 3)
+                    if r.latency_s is not None else None
+                ),
+                "code": r.code,
+                "reason": r.reason,
+            }
+
+        oks = [
+            r for r in report.records
+            if r.status == "ok" and r.latency_s is not None
+        ]
+        worst = max(oks, key=lambda r: r.latency_s) if oks else None
+        lost = [r for r in report.records if r.status == "lost"]
+        untyped = [r for r in report.records if r.untyped]
+        return {
+            "worst_latency": entry(worst) if worst is not None else None,
+            "lost": [entry(r) for r in lost[:limit]],
+            "untyped": [entry(r) for r in untyped[:limit]],
+        }
 
     # -- the invariants ----------------------------------------------------
 

@@ -40,6 +40,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from keystone_tpu.loadgen.trace import TraceEvent
+from keystone_tpu.observability.tracing import TRACE_RESPONSE_HEADER
 
 logger = logging.getLogger(__name__)
 
@@ -74,6 +75,10 @@ class RequestRecord:
     code: Optional[int] = None    # HTTP status (http target only)
     reason: Optional[str] = None  # typed shed reason / error detail
     untyped: bool = False         # True for non-typed failures
+    # the server's X-Keystone-Trace echo (success AND typed shed):
+    # the record's handle into /debugz?trace_id= forensics — what the
+    # verdict's exemplars surface for the worst/lost/untyped requests
+    trace_id: Optional[str] = None
 
     @property
     def behind_s(self) -> float:
@@ -210,6 +215,7 @@ class HttpTarget:
                 return RequestRecord(
                     0, 0.0, 0.0, "ok", n_rows=event.n_rows,
                     latency_s=latency, code=resp.status,
+                    trace_id=resp.headers.get(TRACE_RESPONSE_HEADER),
                 )
         except urllib.error.HTTPError as e:
             latency = time.perf_counter() - t0
@@ -227,6 +233,9 @@ class HttpTarget:
                 0, 0.0, 0.0, "shed" if typed else "error",
                 n_rows=event.n_rows, latency_s=latency, code=e.code,
                 reason=reason, untyped=not typed,
+                # typed sheds carry the trace header too — by design:
+                # a shed client needs the forensic handle MOST
+                trace_id=e.headers.get(TRACE_RESPONSE_HEADER),
             )
         except Exception as e:
             # transport timeout / connection drop: the request was
@@ -294,6 +303,21 @@ class InprocTarget:
         )
         t0 = time.perf_counter()
         futures = []
+        # mirror the HTTP header capture: the admission layer rides
+        # each future's trace id; the first instance's id stands for
+        # the request in the verdict's exemplars
+        def _tid():
+            return next(
+                (
+                    tid
+                    for tid in (
+                        getattr(f, "trace_id", None) for f in futures
+                    )
+                    if tid
+                ),
+                None,
+            )
+
         try:
             for row in xs:
                 futures.append(
@@ -309,6 +333,7 @@ class InprocTarget:
             return RequestRecord(
                 0, 0.0, 0.0, "shed", n_rows=event.n_rows,
                 latency_s=time.perf_counter() - t0, reason=e.reason,
+                trace_id=_tid(),
             )
         except (_FutTimeout, TimeoutError):
             for f in futures:
@@ -316,6 +341,7 @@ class InprocTarget:
             return RequestRecord(
                 0, 0.0, 0.0, "lost", n_rows=event.n_rows,
                 reason=f"future unresolved after {timeout:.0f}s",
+                trace_id=_tid(),
             )
         except Exception as e:
             for f in futures:
@@ -324,10 +350,11 @@ class InprocTarget:
                 0, 0.0, 0.0, "error", n_rows=event.n_rows,
                 latency_s=time.perf_counter() - t0,
                 reason=f"{type(e).__name__}: {e}", untyped=True,
+                trace_id=_tid(),
             )
         return RequestRecord(
             0, 0.0, 0.0, "ok", n_rows=event.n_rows,
-            latency_s=time.perf_counter() - t0,
+            latency_s=time.perf_counter() - t0, trace_id=_tid(),
         )
 
     def ready(self) -> bool:

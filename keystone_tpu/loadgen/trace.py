@@ -59,6 +59,11 @@ class TraceEvent:
     trace_id: Optional[str] = None
     post_seq: Optional[Any] = None  # shared by lines of one POST
     # (opaque id — a "nonce-counter" string from the gateway)
+    # fleet-tier fields (serve-router --request-log): which replica
+    # served the POST and how many forward attempts it took — ride
+    # along for analysis, don't drive the replay
+    replica: Optional[str] = None
+    attempts: Optional[int] = None
 
 
 def parse_request_log_line(line: str) -> Optional[TraceEvent]:
@@ -94,6 +99,8 @@ def parse_request_log_line(line: str) -> Optional[TraceEvent]:
             lane=doc.get("lane"),
             trace_id=doc.get("trace_id"),
             post_seq=doc.get("post_seq"),
+            replica=doc.get("replica"),
+            attempts=doc.get("attempts"),
         )
     except (TypeError, ValueError):
         return None

@@ -55,12 +55,20 @@ from keystone_tpu.observability.registry import (
     reset_global_registry,
 )
 from keystone_tpu.observability.slo import Slo, SloMonitor
+from keystone_tpu.observability.stitch import (
+    StitchedTrace,
+    TraceStitcher,
+    phase_decomposition,
+)
 from keystone_tpu.observability.tracing import (
     Span,
+    TraceContext,
     Tracer,
     disable_tracing,
     enable_tracing,
+    format_traceparent,
     get_tracer,
+    parse_traceparent,
 )
 
 __all__ = [
@@ -82,12 +90,18 @@ __all__ = [
     "Slo",
     "SloMonitor",
     "Span",
+    "StitchedTrace",
+    "TraceContext",
+    "TraceStitcher",
     "Tracer",
     "build_info",
     "disable_tracing",
     "enable_tracing",
+    "format_traceparent",
     "get_global_registry",
     "get_tracer",
+    "parse_traceparent",
+    "phase_decomposition",
     "reset_global_registry",
     "start_admin_server",
     "stop_admin_server",

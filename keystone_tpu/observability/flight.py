@@ -46,17 +46,26 @@ def recorders() -> List["FlightRecorder"]:
 
 def debugz_status(trace_id: Optional[str] = None) -> Dict:
     """The admin ``/debugz`` document: every record of every live
-    recorder (newest first), optionally filtered to one trace."""
+    recorder (newest first), optionally filtered to one trace. A
+    ``trace_id`` query ALSO returns that trace's live spans straight
+    from the tracer ring (``"spans"``): ordinary requests are never
+    tail-sampled into a flight record, but the fleet router's
+    cross-process stitch (``observability/stitch.py``) still needs
+    their span tree while the ring holds it — pinned forensics when
+    they exist, the ring as the fallback."""
     records: List[FlightRecord] = []
     for rec in recorders():
         records.extend(rec.records())
     records.sort(key=lambda r: r.captured_at, reverse=True)
+    doc: Dict[str, Any] = {"recorders": len(recorders())}
     if trace_id is not None:
         records = [r for r in records if r.trace_id == trace_id]
-    return {
-        "recorders": len(recorders()),
-        "records": [r.to_dict() for r in records],
-    }
+        doc["trace_id"] = trace_id
+        doc["spans"] = [
+            s.to_dict() for s in get_tracer().spans_for_trace(trace_id)
+        ]
+    doc["records"] = [r.to_dict() for r in records]
+    return doc
 
 
 def find_record(trace_id: str) -> Optional["FlightRecord"]:

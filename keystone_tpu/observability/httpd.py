@@ -1,23 +1,48 @@
 """Shared stdlib-HTTP scaffolding for the background endpoints.
 
-The admin plane (``observability/admin.py``) and the gateway frontend
-(``gateway/http.py``) are both the same shape: a ``ThreadingHTTPServer``
-on a daemon thread, bound to localhost by default, ``port=0`` for an
-ephemeral port, JSON/text responses with explicit Content-Length, and a
-clean ``start()``/``stop()``/context-manager lifecycle. This module is
-that shape, once — a fix to binding, shutdown, or response framing
-lands in both endpoints.
+The admin plane (``observability/admin.py``), the gateway frontend
+(``gateway/http.py``), and the fleet router (``fleet/router.py``) are
+all the same shape: a ``ThreadingHTTPServer`` on a daemon thread, bound
+to localhost by default, ``port=0`` for an ephemeral port, JSON/text
+responses with explicit Content-Length, and a clean
+``start()``/``stop()``/context-manager lifecycle. This module is that
+shape, once — a fix to binding, shutdown, or response framing lands in
+every endpoint.
+
+``RequestLogWriter`` is the shared ``--request-log`` sink: one JSON
+line per request, stdout or a line-buffered JSONL file, concurrent
+handler threads kept whole under one lock. The gateway and the router
+both write the same schema through it, which is what keeps fleet
+recordings replayable by the same ``loadgen/trace.py`` parser.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
+import random
+import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 logger = logging.getLogger(__name__)
+
+# per-POST identity for request logs: concurrent handler threads
+# interleave their lines, so a replayer can't rely on adjacency —
+# lines from one POST share a post_seq instead (next() on
+# itertools.count is atomic under the GIL). The random per-process
+# prefix keeps ids unique across restarts: request logs open in
+# APPEND mode, and a counter restarting at 1 would make a second
+# session's posts dedupe away against the first's.
+_POST_NONCE = "%08x" % random.getrandbits(32)
+_POST_SEQ = itertools.count(1)
+
+
+def next_post_seq() -> str:
+    """A process-unique per-POST id for ``--request-log`` lines."""
+    return f"{_POST_NONCE}-{next(_POST_SEQ)}"
 
 
 class JsonHandler(BaseHTTPRequestHandler):
@@ -41,12 +66,17 @@ class JsonHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _send_json(
-        self, obj, code: int = 200, indent: Optional[int] = None
+        self,
+        obj,
+        code: int = 200,
+        indent: Optional[int] = None,
+        headers: Optional[dict] = None,
     ) -> None:
         self._send(
             code,
             json.dumps(obj, indent=indent, default=str).encode("utf-8"),
             "application/json; charset=utf-8",
+            headers=headers,
         )
 
     def _send_text(
@@ -125,3 +155,53 @@ class BackgroundServer:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+class RequestLogWriter:
+    """The ``--request-log`` sink shared by the gateway frontend and
+    the fleet router: falsy = disabled, True = one JSON line per
+    request on stdout, a path = append line-buffered JSONL there (the
+    loadgen record/replay path — no process-output scraping)."""
+
+    def __init__(self, request_log) -> None:
+        self.enabled = bool(request_log)
+        # the stop() close race (PR 7 review): a straggler handler
+        # thread must re-check this under the lock, never write to a
+        # closed file — the guarded-by rule keeps it that way
+        self._file = None  # guarded-by: _lock
+        self._lock = threading.Lock()
+        self._to_file = isinstance(request_log, (str, bytes)) or hasattr(
+            request_log, "__fspath__"
+        )
+        if self._to_file:
+            self._file = open(  # noqa: SIM115 (held open for the
+                # server's lifetime; close() closes it)
+                request_log, "a", buffering=1, encoding="utf-8",
+            )
+
+    def write(self, line: dict) -> None:
+        """One record to the log (stdout or the file). Handler threads
+        are concurrent; the lock keeps lines whole."""
+        text = json.dumps(line)
+        if not self._to_file:
+            with self._lock:
+                # one write() call for text+newline, under the lock:
+                # print() issues two writes and concurrent handler
+                # threads would interleave mid-line, producing merged
+                # lines the trace parser drops
+                sys.stdout.write(text + "\n")
+                sys.stdout.flush()
+            return
+        with self._lock:
+            # re-read under the lock: daemon handler threads are not
+            # joined by stop(), so a straggler can race the close —
+            # it must drop its line, not write to a closed file
+            out = self._file
+            if out is not None:
+                out.write(text + "\n")
+
+    def close(self) -> None:
+        if self._file is not None:
+            with self._lock:
+                self._file.close()
+                self._file = None

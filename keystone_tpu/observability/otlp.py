@@ -83,14 +83,26 @@ def span_to_otlp(span: Span) -> Dict[str, Any]:
 
 
 def encode_spans(
-    spans: Sequence[Span], service_name: str = "keystone-tpu"
+    spans: Sequence[Span],
+    service_name: str = "keystone-tpu",
+    resource_attrs: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
-    """A batch of spans as the full OTLP/HTTP JSON request body."""
+    """A batch of spans as the full OTLP/HTTP JSON request body.
+    ``resource_attrs`` stamp the RESOURCE (the process), not the
+    spans: the fleet's ``service.name`` + ``replica`` identity is
+    what lets an external collector lay N processes' halves of one
+    trace out as the same stitched topology the router's ``/debugz``
+    renders."""
     return {
         "resourceSpans": [
             {
                 "resource": {
-                    "attributes": _attrs({"service.name": service_name})
+                    "attributes": _attrs(
+                        {
+                            "service.name": service_name,
+                            **(resource_attrs or {}),
+                        }
+                    )
                 },
                 "scopeSpans": [
                     {
@@ -111,6 +123,7 @@ class OtlpSpanExporter:
         endpoint: str,
         *,
         service_name: str = "keystone-tpu",
+        resource_attrs: Optional[Dict[str, Any]] = None,
         headers: Optional[Dict[str, str]] = None,
         batch_size: int = 256,
         flush_interval_s: float = 2.0,
@@ -123,6 +136,7 @@ class OtlpSpanExporter:
             endpoint += TRACES_PATH
         self.endpoint = endpoint
         self.service_name = service_name
+        self.resource_attrs = dict(resource_attrs or {})
         self.headers = dict(headers or {})
         self.batch_size = max(1, int(batch_size))
         self.flush_interval_s = float(flush_interval_s)
@@ -225,7 +239,10 @@ class OtlpSpanExporter:
             self._spans.inc(("dropped",), by=len(batch))
             return
         body = json.dumps(
-            encode_spans(batch, self.service_name)
+            encode_spans(
+                batch, self.service_name,
+                resource_attrs=self.resource_attrs,
+            )
         ).encode("utf-8")
         req = urllib.request.Request(
             self.endpoint,

@@ -52,6 +52,77 @@ def new_trace_id() -> str:
     return os.urandom(16).hex()
 
 
+# -- W3C trace context (the cross-process wire format) ----------------------
+
+# https://www.w3.org/TR/trace-context/: version "00" header is
+# `00-<32 hex trace-id>-<16 hex parent-id>-<2 hex flags>`. The fleet
+# router emits it on every forwarded /predict; the gateway adopts the
+# trace id so one request is ONE trace across processes.
+TRACEPARENT_HEADER = "traceparent"
+
+# the RESPONSE header both serving tiers echo the request's trace id
+# on (success AND typed shed): one constant, because the gateway, the
+# router, and the loadgen client all speak it — a casing drift in one
+# tier would silently turn every client-side trace id into None
+TRACE_RESPONSE_HEADER = "X-Keystone-Trace"
+
+_HEX = frozenset("0123456789abcdef")
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """A parsed ``traceparent``: the remote caller's trace identity.
+    ``parent_span_id`` is the REMOTE process's span id (16 hex chars)
+    — it never maps onto this process's integer span ids, so adopters
+    take the ``trace_id`` and record the remote parent as an attr."""
+
+    trace_id: str
+    parent_span_id: str
+    flags: str = "01"
+
+
+def _is_hex(s: str, width: int) -> bool:
+    return len(s) == width and all(c in _HEX for c in s)
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[TraceContext]:
+    """A ``traceparent`` header value -> ``TraceContext``, or None for
+    absent/malformed/all-zero input (the W3C spec says a receiver that
+    cannot parse the header MUST restart the trace — minting a fresh
+    id, never half-adopting garbage)."""
+    if not header:
+        return None
+    parts = header.strip().lower().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, parent_id, flags = parts[0], parts[1], parts[2], parts[3]
+    if not _is_hex(version, 2) or version == "ff":
+        return None
+    if version == "00" and len(parts) != 4:
+        # version 00 defines EXACTLY four fields; trailing data makes
+        # the header unparseable and the trace restarts (the spec's
+        # rule) — only future versions may append fields
+        return None
+    if not _is_hex(trace_id, 32) or trace_id == "0" * 32:
+        return None
+    if not _is_hex(parent_id, 16) or parent_id == "0" * 16:
+        return None
+    if not _is_hex(flags, 2):
+        return None
+    return TraceContext(trace_id=trace_id, parent_span_id=parent_id, flags=flags)
+
+
+def format_traceparent(trace_id: str, span_id: Optional[int]) -> str:
+    """The outbound header for a span in THIS process: our integer
+    span ids render as the 8-byte hex field the wire expects (same
+    mapping the OTLP exporter uses), sampled flag always set — the
+    downstream process decides its own recording, we only carry
+    identity."""
+    return "00-{}-{:016x}-01".format(
+        trace_id, (span_id or 0) & ((1 << 64) - 1)
+    )
+
+
 @dataclasses.dataclass
 class Span:
     name: str
@@ -157,22 +228,30 @@ class Tracer:
         return stack
 
     def start_span(
-        self, name: str, parent_id: Optional[int] = None, **attrs: Any
+        self,
+        name: str,
+        parent_id: Optional[int] = None,
+        trace_id: Optional[str] = None,
+        **attrs: Any,
     ):
         """Explicit API (use ``span()`` where a ``with`` block fits).
         The new span's parent is this thread's innermost open span,
         unless ``parent_id`` pins it explicitly — the cross-thread case,
         e.g. a micro-batch window on the dispatcher thread parenting
-        under the ``gateway.admit`` span of the request that opened it."""
+        under the ``gateway.admit`` span of the request that opened it.
+        ``trace_id`` ADOPTS a caller-supplied identity (an inbound W3C
+        ``traceparent``'s) instead of minting one — the cross-PROCESS
+        case; it wins over any inherited/mapped id so a forwarded
+        request stays one trace fleet-wide."""
         if not self.enabled:
             return _NULL_SPAN
         stack = self._stack()
-        trace_id = None
         if parent_id is None:
             if stack:
                 parent_id = stack[-1].span_id
-                trace_id = stack[-1].trace_id
-        else:
+                if trace_id is None:
+                    trace_id = stack[-1].trace_id
+        elif trace_id is None:
             # explicit cross-thread parent: join its trace if we still
             # know it (bounded map); else this span roots a new trace
             with self._lock:
@@ -229,23 +308,34 @@ class Tracer:
 
     @contextlib.contextmanager
     def _span_cm(
-        self, name: str, parent_id: Optional[int], attrs: Dict[str, Any]
+        self,
+        name: str,
+        parent_id: Optional[int],
+        trace_id: Optional[str],
+        attrs: Dict[str, Any],
     ):
-        span = self.start_span(name, parent_id=parent_id, **attrs)
+        span = self.start_span(
+            name, parent_id=parent_id, trace_id=trace_id, **attrs
+        )
         try:
             yield span
         finally:
             self.end_span(span)
 
     def span(
-        self, name: str, parent_id: Optional[int] = None, **attrs: Any
+        self,
+        name: str,
+        parent_id: Optional[int] = None,
+        trace_id: Optional[str] = None,
+        **attrs: Any,
     ):
         """``with tracer.span("serving.dispatch", bucket=8):`` — records
         nothing when the tracer is disabled. ``parent_id`` pins the
-        parent explicitly (cross-thread chains)."""
+        parent explicitly (cross-thread chains); ``trace_id`` adopts a
+        remote trace identity (cross-process chains)."""
         if not self.enabled:
             return _NULL_SPAN
-        return self._span_cm(name, parent_id, attrs)
+        return self._span_cm(name, parent_id, trace_id, attrs)
 
     def current_span(self):
         stack = getattr(self._local, "stack", None)
@@ -347,3 +437,20 @@ def tracez_document(
         "enabled": tracer.enabled,
         "spans": [s.to_dict() for s in tracer.recent(n)],
     }
+
+
+__all__ = [
+    "DEFAULT_CAPACITY",
+    "Span",
+    "TRACEPARENT_HEADER",
+    "TRACE_RESPONSE_HEADER",
+    "TraceContext",
+    "Tracer",
+    "disable_tracing",
+    "enable_tracing",
+    "format_traceparent",
+    "get_tracer",
+    "new_trace_id",
+    "parse_traceparent",
+    "tracez_document",
+]

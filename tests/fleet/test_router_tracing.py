@@ -1,0 +1,326 @@
+"""Distributed tracing across the fleet hop, over real sockets: W3C
+``traceparent`` round-trip (router → replica → stitched ``/debugz``),
+retry attempts as sibling spans, the ``X-Keystone-Trace`` echo on
+success AND typed shed, phase decomposition summing to the measured
+latency, the ``router.trace.drop`` graceful-degradation drill, and
+``serve-router --request-log`` parity with the gateway's replayable
+schema."""
+
+import itertools
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from keystone_tpu.fleet import RouterServer
+from keystone_tpu.gateway import Gateway, GatewayServer
+from keystone_tpu.loadgen import faults
+from keystone_tpu.loadgen import trace as trace_mod
+from keystone_tpu.observability import tracing
+from keystone_tpu.observability.prometheus import parse_samples
+from keystone_tpu.observability.registry import MetricsRegistry
+
+from gateway_fixtures import D, make_fitted
+
+_ids = itertools.count()
+
+
+@pytest.fixture(autouse=True)
+def traced():
+    """Every test here runs with the process-global tracer ON (the
+    serve-router default) and restores the disabled default after."""
+    tracing.enable_tracing()
+    yield tracing.get_tracer()
+    tracing.disable_tracing()
+
+
+def _make_replica(name):
+    reg = MetricsRegistry()
+    gw = Gateway(
+        make_fitted(),
+        buckets=(4, 8),
+        n_lanes=1,
+        max_delay_ms=1.0,
+        warmup_example=np.zeros(D, np.float32),
+        name=name,
+        registry=reg,
+    )
+    srv = GatewayServer(gw, port=0, registry=reg).start()
+    return gw, srv
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    replicas = [
+        _make_replica(f"trace-r{next(_ids)}") for _ in range(2)
+    ]
+    router = RouterServer(
+        [srv.url() for _, srv in replicas],
+        port=0,
+        name=f"trace-router{next(_ids)}",
+        registry=MetricsRegistry(),
+        probe_interval_s=0.1,
+        recovery_after_s=0.3,
+        request_log=str(tmp_path / "router-requests.jsonl"),
+    ).start()
+    router.fleet.probe_once()
+    yield router, replicas, tmp_path / "router-requests.jsonl"
+    router.stop()
+    for gw, srv in replicas:
+        gw.close()
+        srv.stop()
+
+
+def _predict(url, headers=None, timeout=30):
+    body = json.dumps({"instances": [[0.5] * D]}).encode()
+    req = urllib.request.Request(
+        url + "/predict",
+        data=body,
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        resp.read()
+        return (
+            resp.status,
+            resp.headers.get("X-Keystone-Trace"),
+            time.perf_counter() - t0,
+        )
+
+
+def _get_json(url, timeout=15):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _metric_value(text, family, want_labels):
+    total = 0.0
+    found = False
+    for name, labels, value in parse_samples(text):
+        if name == family and all(
+            labels.get(k) == v for k, v in want_labels.items()
+        ):
+            total += value
+            found = True
+    return total if found else None
+
+
+# -- one trace id across processes ------------------------------------------
+
+
+def test_inbound_traceparent_is_adopted_fleet_wide(fleet, traced):
+    """A client-minted traceparent survives client → router → replica:
+    the echoed header, the router's forward span, and the replica's
+    admit/coalesce chain all carry the CLIENT's trace id."""
+    router, replicas, _ = fleet
+    tid = tracing.new_trace_id()
+    header = tracing.format_traceparent(tid, 7)
+    status, echoed, _ = _predict(
+        router.url(), headers={"traceparent": header}
+    )
+    assert status == 200
+    assert echoed == tid
+    time.sleep(0.3)
+    spans = traced.spans_for_trace(tid)
+    names = {s.name for s in spans}
+    assert "router.forward" in names
+    assert "gateway.admit" in names, names
+    assert "microbatch.coalesce" in names, names
+
+
+def test_cross_process_round_trip_stitches_at_the_router(fleet, traced):
+    """The acceptance path: one /predict with no client context yields
+    ONE minted trace id visible in the response header, both
+    processes' span rings, and the router's stitched /debugz — with
+    router-hop and replica spans in one tree and phases summing to
+    within 10% of the stitched total."""
+    router, replicas, _ = fleet
+    status, tid, measured_s = _predict(router.url())
+    assert status == 200 and tid
+    time.sleep(0.4)  # replica stage spans end just after the response
+
+    doc = _get_json(router.url(f"/debugz?trace_id={tid}"))
+    assert not doc["partial"], doc["partial_detail"]
+    assert len(doc["processes"]) == 2, doc["processes"]
+    names = {s["name"] for s in doc["spans"]}
+    assert {"router.forward", "gateway.admit"} <= names, names
+    # replica roots grafted under the router hop
+    grafted = [s for s in doc["spans"] if s.get("grafted")]
+    assert grafted
+    forward_ids = {
+        s["span_id"] for s in doc["spans"]
+        if s["name"] == "router.forward"
+    }
+    assert {s["parent_id"] for s in grafted} <= forward_ids
+
+    phases = doc["phases_ms"]
+    assert set(phases) == {
+        "router_hop", "queue_wait", "coalesce", "device", "deliver",
+    }
+    total = doc["total_ms"]
+    assert abs(sum(phases.values()) - total) <= 0.1 * total
+    # the stitched total is the router-measured forward; client adds
+    # only its own hop on localhost
+    assert total <= measured_s * 1e3 + 1.0
+
+    chrome = _get_json(
+        router.url(f"/debugz?trace_id={tid}&format=chrome")
+    )
+    pids = {
+        e["pid"] for e in chrome["traceEvents"] if e.get("ph") == "X"
+    }
+    assert len(pids) == 2, pids
+    # the phase family landed on the router registry -> federation
+    fed = urllib.request.urlopen(
+        router.url("/metrics"), timeout=15
+    ).read().decode()
+    assert "keystone_request_phase_seconds_bucket" in fed
+
+
+def test_unknown_trace_404s_and_missing_id_400s(fleet):
+    router, _, _ = fleet
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get_json(router.url("/debugz?trace_id=" + "ab" * 16))
+    assert err.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get_json(router.url("/debugz"))
+    assert err.value.code == 400
+
+
+# -- retries are sibling spans ----------------------------------------------
+
+
+def test_retry_produces_sibling_spans_with_retry_reason(fleet, traced):
+    """A black-holed first attempt must show up in the trace as TWO
+    root-level router.forward siblings — the failed hop (error attr)
+    and the winning retry (retry_reason attr naming why)."""
+    router, replicas, _ = fleet
+    faults.arm("router.replica.blackhole", count=1)
+    status, tid, _ = _predict(router.url())
+    assert status == 200 and tid
+    forwards = [
+        s for s in traced.spans_for_trace(tid)
+        if s.name == "router.forward"
+    ]
+    assert len(forwards) == 2
+    assert all(s.parent_id is None for s in forwards), (
+        "attempts must be SIBLINGS (roots), not nested"
+    )
+    first, second = sorted(forwards, key=lambda s: s.attrs["attempt"])
+    assert "error" in first.attrs
+    assert "blackhole" in second.attrs["retry_reason"]
+    assert second.attrs["status"] == 200
+    assert first.attrs["replica"] != second.attrs["replica"]
+
+
+# -- the echo survives typed sheds ------------------------------------------
+
+
+def test_typed_shed_response_carries_trace_header(fleet):
+    """A fleet-wide drain propagates the replicas' typed 503-closed —
+    and that shed response must STILL carry X-Keystone-Trace: the
+    shed client needs the forensic handle most."""
+    router, replicas, _ = fleet
+    for gw, _srv in replicas:
+        gw.close()
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _predict(router.url())
+    assert err.value.code == 503
+    doc = json.loads(err.value.read())
+    assert doc["error"] == "overloaded"
+    assert err.value.headers.get("X-Keystone-Trace"), (
+        "typed shed lost the trace id"
+    )
+
+
+def test_gateway_typed_shed_carries_trace_header(fleet):
+    """Same contract one tier down: the REPLICA's own typed shed
+    (direct hit, closed gateway) echoes the inbound trace id."""
+    _router, replicas, _ = fleet
+    gw, srv = replicas[0]
+    gw.close()
+    tid = tracing.new_trace_id()
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _predict(
+            srv.url(),
+            headers={"traceparent": tracing.format_traceparent(tid, 3)},
+        )
+    assert err.value.code == 503
+    assert err.value.headers.get("X-Keystone-Trace") == tid
+
+
+# -- router.trace.drop: graceful degradation --------------------------------
+
+
+def test_trace_drop_degrades_to_counted_partial_stitch(fleet, traced):
+    """With ``router.trace.drop`` armed the forward loses its
+    traceparent: serving is unaffected, the replica self-mints a
+    DIFFERENT id, and the router's stitch returns its partial
+    router-side tree with keystone_trace_stitch_partial_total
+    counted."""
+    router, replicas, _ = fleet
+    faults.arm("router.trace.drop")
+    try:
+        status, tid, _ = _predict(router.url())
+    finally:
+        faults.disarm("router.trace.drop")
+    assert status == 200 and tid, "serving must be unaffected"
+    time.sleep(0.3)
+    # the replica minted its own id: the router's id has no replica
+    # spans anywhere
+    replica_span_names = {
+        s.name
+        for s in traced.spans_for_trace(tid)
+    }
+    assert "gateway.admit" not in replica_span_names
+    doc = _get_json(router.url(f"/debugz?trace_id={tid}"))
+    assert doc["partial"] is True
+    assert doc["processes"] == [router.name]
+    assert any("no spans" in d for d in doc["partial_detail"])
+    # phases degrade to router_hop-only, never crash
+    assert doc["phases_ms"]["router_hop"] == doc["total_ms"]
+    fed = urllib.request.urlopen(
+        router.url("/metrics"), timeout=15
+    ).read().decode()
+    partials = _metric_value(
+        fed, "keystone_trace_stitch_partial_total",
+        {"reason": "no_spans"},
+    )
+    assert partials is not None and partials >= 1
+
+
+# -- --request-log parity ----------------------------------------------------
+
+
+def test_router_request_log_is_replayable_with_fleet_fields(fleet):
+    """The router's --request-log lines parse with the SAME loadgen
+    trace parser as the gateway's, replay with real n_rows/shape, and
+    carry the fleet fields (replica, attempts, trace_id)."""
+    router, replicas, log_path = fleet
+    for _ in range(3):
+        status, tid, _ = _predict(router.url())
+        assert status == 200
+    router.stop()  # flush/close the log file
+    lines = log_path.read_text().splitlines()
+    assert len(lines) == 3
+    events = trace_mod.parse_request_log(lines)
+    assert len(events) == 3
+    for ev in events:
+        assert ev.status == 200
+        assert ev.n_rows == 1
+        assert ev.shape == (D,)
+        assert ev.trace_id
+        assert ev.attempts == 1
+        assert ev.replica in {
+            r.name for r in router.fleet.replicas()
+        }
+        assert ev.post_seq is not None
+    # collapse_posts dedupes by post_seq — one event per POST
+    assert len(trace_mod.collapse_posts(events)) == 3
+    # and the whole file round-trips through load_trace (normalize)
+    loaded = trace_mod.load_trace(str(log_path))
+    assert len(loaded) == 3
+    assert loaded[0].ts == 0.0

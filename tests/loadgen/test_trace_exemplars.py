@@ -1,0 +1,153 @@
+"""Loadgen's forensic handles: ``HttpTarget`` records the server's
+``X-Keystone-Trace`` echo per request, the verdict surfaces exemplar
+trace ids (worst-latency + every lost/untyped request), and the CLI
+prints them as ready-to-curl ``/debugz?trace_id=`` URLs."""
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+import threading
+
+import pytest
+
+from keystone_tpu.loadgen.cli import _print_forensic_urls
+from keystone_tpu.loadgen.invariants import InvariantChecker
+from keystone_tpu.loadgen.runner import (
+    HttpTarget,
+    LoadReport,
+    RequestRecord,
+)
+from keystone_tpu.loadgen.trace import (
+    TraceEvent,
+    parse_request_log_line,
+)
+
+
+class _StubGateway(BaseHTTPRequestHandler):
+    """Answers /predict with a fixed X-Keystone-Trace header; /shed
+    sheds typed WITH the header (the contract under test)."""
+
+    trace_id = "fe" * 16
+
+    def do_POST(self):  # noqa: N802 (stdlib handler API)
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        self.rfile.read(length)
+        if self.path == "/predict":
+            body = json.dumps({"predictions": [[1.0]]}).encode()
+            code = 200
+        else:
+            body = json.dumps(
+                {"error": "overloaded", "reason": "queue_full"}
+            ).encode()
+            code = 429
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("X-Keystone-Trace", self.trace_id)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+@pytest.fixture
+def stub_url():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _StubGateway)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_http_target_records_trace_header_on_ok(stub_url):
+    rec = HttpTarget(stub_url).send(TraceEvent(ts=0.0, shape=(2,)))
+    assert rec.status == "ok"
+    assert rec.trace_id == _StubGateway.trace_id
+
+
+def test_http_target_records_trace_header_on_typed_shed(stub_url):
+    target = HttpTarget(stub_url)
+    target.base_url = stub_url + "/x"  # routes POSTs to the shed path
+    rec = target.send(TraceEvent(ts=0.0, shape=(2,)))
+    assert rec.status == "shed"
+    assert rec.trace_id == _StubGateway.trace_id
+
+
+# -- verdict exemplars -------------------------------------------------------
+
+
+def _report(records):
+    report = LoadReport()
+    for r in records:
+        report.add(r)
+    report.issued = len(records)
+    report.duration_s = 1.0
+    return report
+
+
+def test_verdict_lists_exemplars_for_worst_lost_and_untyped():
+    report = _report([
+        RequestRecord(0, 0.0, 0.0, "ok", latency_s=0.010,
+                      trace_id="aa" * 16),
+        RequestRecord(1, 0.1, 0.1, "ok", latency_s=0.500,
+                      trace_id="bb" * 16),
+        RequestRecord(2, 0.2, 0.2, "lost", reason="timeout"),
+        RequestRecord(3, 0.3, 0.3, "error", code=500, untyped=True,
+                      trace_id="cc" * 16, reason="internal"),
+    ])
+    verdict = InvariantChecker().check(report)
+    assert not verdict.passed  # lost + untyped
+    ex = verdict.stats["exemplars"]
+    assert ex["worst_latency"]["trace_id"] == "bb" * 16
+    assert ex["worst_latency"]["latency_ms"] == 500.0
+    assert [e["index"] for e in ex["lost"]] == [2]
+    assert ex["lost"][0]["trace_id"] is None  # lost = no response
+    assert [e["trace_id"] for e in ex["untyped"]] == ["cc" * 16]
+    # exemplars survive the JSON round trip the CLI/report emit
+    assert json.loads(verdict.to_json())["stats"]["exemplars"] == ex
+
+
+def test_green_verdict_still_carries_worst_latency_exemplar():
+    report = _report([
+        RequestRecord(0, 0.0, 0.0, "ok", latency_s=0.010,
+                      trace_id="aa" * 16),
+    ])
+    verdict = InvariantChecker().check(report)
+    assert verdict.passed
+    ex = verdict.stats["exemplars"]
+    assert ex["worst_latency"]["trace_id"] == "aa" * 16
+    assert ex["lost"] == [] and ex["untyped"] == []
+
+
+def test_cli_prints_ready_to_curl_debugz_urls(capsys):
+    _print_forensic_urls("http://r:1/", {
+        "worst_latency": {"index": 7, "trace_id": "aa" * 16},
+        "lost": [{"index": 9, "trace_id": None}],
+        "untyped": [{"index": 11, "trace_id": "bb" * 16}],
+    })
+    out = capsys.readouterr().out
+    assert (
+        "worst-latency (request #7): "
+        f"curl 'http://r:1/debugz?trace_id={'aa' * 16}'" in out
+    )
+    assert "lost (request #9): no trace id" in out
+    assert f"curl 'http://r:1/debugz?trace_id={'bb' * 16}'" in out
+
+
+# -- fleet fields parse ------------------------------------------------------
+
+
+def test_parser_tolerates_router_fields():
+    line = json.dumps({
+        "ts": 12.5, "path": "/predict", "status": 200,
+        "latency_ms": 9.1, "lane": None, "trace_id": "ab" * 16,
+        "n_rows": 2, "shape": [4], "deadline_ms": None,
+        "post_seq": "deadbeef-1", "replica": "127.0.0.1:8000",
+        "attempts": 2,
+    })
+    ev = parse_request_log_line(line)
+    assert ev is not None
+    assert ev.replica == "127.0.0.1:8000"
+    assert ev.attempts == 2
+    assert ev.n_rows == 2 and ev.shape == (4,)

@@ -240,3 +240,29 @@ class TestExporter:
         )
         assert spans_c.get(("exported",)) == 1
         assert posts_c.get(("ok",)) == 1
+
+
+def test_encode_spans_stamps_resource_attrs():
+    """The fleet's resource identity (service.name + replica) rides
+    the OTLP RESOURCE, not the spans, so an external collector lays N
+    processes' halves of one trace out as the stitched topology."""
+    from keystone_tpu.observability.otlp import encode_spans
+    from keystone_tpu.observability.tracing import Span
+
+    span = Span(
+        name="router.forward", span_id=1, parent_id=None,
+        start_s=1.0, duration_s=0.01, thread_id=1, attrs={},
+        trace_id="ab" * 16,
+    )
+    doc = encode_spans(
+        [span], service_name="keystone-router",
+        resource_attrs={"replica": "host-a:8000"},
+    )
+    attrs = {
+        kv["key"]: kv["value"]["stringValue"]
+        for kv in doc["resourceSpans"][0]["resource"]["attributes"]
+    }
+    assert attrs == {
+        "service.name": "keystone-router",
+        "replica": "host-a:8000",
+    }

@@ -1,0 +1,349 @@
+"""Cross-process trace stitching, unit level (no sockets): span-id
+qualification, grafting, the phase-decomposition arithmetic, partial
+handling + its counter, and the ``keystone_request_phase_seconds``
+federation golden strings."""
+
+import pytest
+
+from keystone_tpu.observability import prometheus
+from keystone_tpu.observability.registry import MetricsRegistry
+from keystone_tpu.observability.stitch import (
+    PHASES,
+    TraceStitcher,
+    phase_decomposition,
+    qualify_spans,
+)
+from keystone_tpu.observability.tracing import Tracer
+
+TID = "ab" * 16
+
+
+def span_dict(
+    name,
+    span_id,
+    start_s,
+    duration_ms,
+    parent_id=None,
+    process=None,
+    **attrs,
+):
+    d = {
+        "name": name,
+        "span_id": span_id,
+        "parent_id": parent_id,
+        "trace_id": TID,
+        "start_s": start_s,
+        "duration_ms": duration_ms,
+        "thread_id": 1,
+        "attrs": attrs,
+    }
+    if process is not None:
+        d["process"] = process
+    return d
+
+
+# -- qualification -----------------------------------------------------------
+
+
+def test_qualify_namespaces_ids_and_degrades_unknown_parents():
+    spans = qualify_spans(
+        [
+            span_dict("a", 1, 0.0, 1.0),
+            span_dict("b", 2, 0.0, 1.0, parent_id=1),
+            span_dict("c", 3, 0.0, 1.0, parent_id=99),  # fell out
+        ],
+        "p0",
+    )
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["a"]["span_id"] == "p0:1"
+    assert by_name["a"]["parent_id"] is None
+    assert by_name["b"]["parent_id"] == "p0:1"
+    assert by_name["c"]["parent_id"] is None
+    assert all(s["process"] == "p0" for s in spans)
+
+
+# -- phase arithmetic --------------------------------------------------------
+
+
+def _stitched_spans():
+    """A hand-built two-process trace with known numbers (seconds):
+    forward [0.000, 0.100]; replica admit starts 0.010, coalesce
+    [0.030, +20ms], dispatch [0.050, +30ms] ending the envelope at
+    0.080."""
+    return (
+        qualify_spans(
+            [
+                span_dict(
+                    "router.forward", 1, 0.0, 100.0,
+                    router="r", replica="host:1",
+                ),
+            ],
+            "r",
+        )
+        + qualify_spans(
+            [
+                span_dict("gateway.admit", 1, 0.010, 5.0),
+                span_dict("microbatch.coalesce", 2, 0.030, 20.0),
+                span_dict("serving.dispatch", 3, 0.050, 30.0),
+            ],
+            "replica:host:1",
+        )
+    )
+
+
+def test_phase_decomposition_partitions_the_forward_duration():
+    doc = phase_decomposition(_stitched_spans(), "r")
+    assert doc["total_ms"] == 100.0
+    ph = doc["phases_ms"]
+    assert set(ph) == set(PHASES)
+    # envelope = 0.010 -> 0.080 = 70ms; hop = 100 - 70 = 30
+    assert ph["router_hop"] == pytest.approx(30.0)
+    # coalesce start - admit start
+    assert ph["queue_wait"] == pytest.approx(20.0)
+    assert ph["coalesce"] == pytest.approx(20.0)
+    assert ph["device"] == pytest.approx(30.0)
+    # remainder
+    assert ph["deliver"] == pytest.approx(0.0)
+    assert sum(ph.values()) == pytest.approx(doc["total_ms"])
+
+
+def test_phase_decomposition_staged_lanes_use_upload_plus_compute():
+    spans = qualify_spans(
+        [
+            span_dict(
+                "router.forward", 1, 0.0, 100.0,
+                router="r", replica="host:1",
+            ),
+        ],
+        "r",
+    ) + qualify_spans(
+        [
+            span_dict("gateway.admit", 1, 0.000, 5.0),
+            span_dict("microbatch.coalesce", 2, 0.010, 10.0),
+            span_dict("pipeline.host_prep", 3, 0.020, 10.0),
+            span_dict("pipeline.upload", 4, 0.030, 10.0),
+            span_dict("pipeline.compute", 5, 0.040, 30.0),
+            span_dict("pipeline.deliver", 6, 0.070, 20.0),
+        ],
+        "replica:host:1",
+    )
+    ph = phase_decomposition(spans, "r")["phases_ms"]
+    assert ph["device"] == pytest.approx(40.0)  # upload + compute
+    assert ph["queue_wait"] == pytest.approx(10.0)
+    assert ph["coalesce"] == pytest.approx(10.0)
+    # envelope 0 -> 90ms; hop 10; deliver = 100-10-10-10-40 = 30
+    assert ph["router_hop"] == pytest.approx(10.0)
+    assert ph["deliver"] == pytest.approx(30.0)
+
+
+def test_phase_decomposition_router_only_is_hop_only():
+    """A partial (router-side) trace reports ONLY the hop: the replica
+    phases are unknown, not zero — absent, per the repo's
+    absent-not-zero doctrine, so partial stitches can't drag the
+    federated phase quantiles toward 0."""
+    spans = qualify_spans(
+        [
+            span_dict(
+                "router.forward", 1, 0.0, 42.0,
+                router="r", replica="host:1",
+            ),
+        ],
+        "r",
+    )
+    doc = phase_decomposition(spans, "r")
+    assert doc["phases_ms"] == {"router_hop": 42.0}
+
+
+def test_phase_decomposition_empty_is_none():
+    assert phase_decomposition([], "r")["total_ms"] is None
+
+
+def test_negative_clock_skew_cannot_go_negative():
+    """A replica whose wall clock is AHEAD (envelope appears after the
+    forward window) must clamp hop/queue to zero, not negative."""
+    spans = qualify_spans(
+        [
+            span_dict(
+                "router.forward", 1, 0.0, 10.0,
+                router="r", replica="host:1",
+            ),
+        ],
+        "r",
+    ) + qualify_spans(
+        [
+            # skewed 1000s into the future, envelope wider than total
+            span_dict("gateway.admit", 1, 1000.0, 30.0),
+            span_dict("microbatch.coalesce", 2, 999.9, 5.0),
+        ],
+        "replica:host:1",
+    )
+    ph = phase_decomposition(spans, "r")["phases_ms"]
+    assert all(v >= 0.0 for v in ph.values()), ph
+
+
+# -- the stitcher over a real tracer ----------------------------------------
+
+
+def _forwarding_tracer(name="r0", replica="h:1"):
+    tracer = Tracer(enabled=True)
+    span = tracer.start_span(
+        "router.forward", trace_id=TID, router=name,
+        replica=replica, attempt=0,
+    )
+    tracer.end_span(span)
+    return tracer
+
+
+def test_stitch_unknown_replica_counts_partial():
+    reg = MetricsRegistry()
+    stitcher = TraceStitcher(
+        name="r0", tracer=_forwarding_tracer(), registry=reg
+    )
+    stitched = stitcher.stitch(TID, lambda name: None)
+    assert stitched.partial is True
+    assert stitched.processes == ["r0"]
+    assert "not in the registry" in stitched.partial_detail[0]
+    counter = reg.counter(
+        "keystone_trace_stitch_partial_total", "", ("reason",)
+    )
+    assert counter.get(("unknown_replica",)) == 1
+
+
+def test_stitch_unreachable_replica_counts_partial():
+    reg = MetricsRegistry()
+    stitcher = TraceStitcher(
+        name="r0", tracer=_forwarding_tracer(), registry=reg,
+        fetch_timeout_s=0.3,
+    )
+    # nothing listens on this port — the fetch must fail fast and
+    # degrade, never raise out of the stitch
+    stitched = stitcher.stitch(
+        TID, lambda name: "http://127.0.0.1:9"
+    )
+    assert stitched.partial is True
+    counter = reg.counter(
+        "keystone_trace_stitch_partial_total", "", ("reason",)
+    )
+    assert counter.get(("unreachable",)) == 1
+    # the document still renders (router-side tree + hop-only phases)
+    assert stitched.to_dict()["phases_ms"]["router_hop"] > 0
+
+
+def test_stitch_unknown_trace_is_none_and_document_404s():
+    reg = MetricsRegistry()
+    stitcher = TraceStitcher(
+        name="r0", tracer=Tracer(enabled=True), registry=reg
+    )
+    assert stitcher.stitch("cd" * 16, lambda name: None) is None
+    code, doc = stitcher.document("cd" * 16, "", lambda name: None)
+    assert code == 404
+    code, doc = stitcher.document(None, "", lambda name: None)
+    assert code == 400
+
+
+def test_stitch_records_phase_histogram():
+    reg = MetricsRegistry()
+    stitcher = TraceStitcher(
+        name="r0", tracer=_forwarding_tracer(), registry=reg
+    )
+    stitcher.stitch(TID, lambda name: None)
+    text = prometheus.render(reg.collect())
+    assert 'keystone_request_phase_seconds_count{phase="router_hop"} 1' in text
+    # a PARTIAL stitch measured only the hop: the replica phases are
+    # unknown and must stay ABSENT from the family, not appear as 0.0
+    # observations dragging the federated quantiles down
+    for phase in PHASES:
+        if phase != "router_hop":
+            assert f'phase="{phase}"' not in text
+
+
+def test_restitching_a_trace_does_not_multiply_count_phases():
+    """The histogram is per-REQUEST: an operator re-querying /debugz
+    (or asking for format=chrome after the JSON) must not skew the
+    family toward investigated requests."""
+    reg = MetricsRegistry()
+    stitcher = TraceStitcher(
+        name="r0", tracer=_forwarding_tracer(), registry=reg
+    )
+    for _ in range(3):
+        stitcher.stitch(TID, lambda name: None)
+    text = prometheus.render(reg.collect())
+    assert 'keystone_request_phase_seconds_count{phase="router_hop"} 1' in text
+
+
+def test_phases_read_only_the_winning_replicas_clock():
+    """A retried trace carries a FAILED attempt's spans from another
+    replica (possibly another host, skewed clock): the decomposition
+    must restrict itself to the winning attempt's replica — the
+    failed attempt's spans can't manufacture phantom queue time."""
+    spans = qualify_spans(
+        [
+            span_dict(
+                "router.forward", 1, 0.0, 20.0,
+                router="r", replica="dead:1", attempt=0,
+                error="untyped 500",
+            ),
+            span_dict(
+                "router.forward", 2, 0.025, 100.0,
+                router="r", replica="win:2", attempt=1,
+            ),
+        ],
+        "r",
+    ) + qualify_spans(
+        # the failed replica's half, on a clock 500s ahead
+        [
+            span_dict("gateway.admit", 1, 500.0, 5.0),
+            span_dict("microbatch.coalesce", 2, 500.1, 5.0),
+        ],
+        "replica:dead:1",
+    ) + qualify_spans(
+        [
+            span_dict("gateway.admit", 1, 0.035, 5.0),
+            span_dict("microbatch.coalesce", 2, 0.055, 20.0),
+            span_dict("serving.dispatch", 3, 0.075, 30.0),
+        ],
+        "replica:win:2",
+    )
+    doc = phase_decomposition(spans, "r")
+    assert doc["total_ms"] == 100.0
+    ph = doc["phases_ms"]
+    # winner envelope 0.035 -> 0.105 = 70ms; hop 30; queue 20
+    assert ph["router_hop"] == pytest.approx(30.0)
+    assert ph["queue_wait"] == pytest.approx(20.0)
+    assert sum(ph.values()) == pytest.approx(100.0)
+
+
+# -- federation golden strings ----------------------------------------------
+
+
+def test_phase_family_federates_by_summing_le_buckets():
+    """Two processes' ``keystone_request_phase_seconds`` expositions
+    merge into one fleet family: identical-label bucket/count/sum
+    samples SUM (the merge_expositions contract every other le family
+    rides) — asserted against golden strings."""
+
+    def exposition(ms_values):
+        reg = MetricsRegistry()
+        stitcher = TraceStitcher(name="r", tracer=None, registry=reg)
+        for ms in ms_values:
+            stitcher._phases.observe(ms / 1e3, ("device",))
+        return prometheus.render(reg.collect())
+
+    a = exposition([0.4, 30.0])   # -> le 0.0005 and le 0.05
+    b = exposition([30.0])
+    merged = prometheus.merge_expositions([a, b], on_conflict="drop")
+    golden = [
+        'keystone_request_phase_seconds_bucket{le="0.0005",phase="device"} 1',
+        'keystone_request_phase_seconds_bucket{le="0.025",phase="device"} 1',
+        'keystone_request_phase_seconds_bucket{le="0.05",phase="device"} 3',
+        'keystone_request_phase_seconds_bucket{le="+Inf",phase="device"} 3',
+        'keystone_request_phase_seconds_count{phase="device"} 3',
+    ]
+    for line in golden:
+        assert line in merged, (line, merged)
+    # and the summed _sum (0.0304 + 0.03, float arithmetic verbatim)
+    (sum_line,) = [
+        line for line in merged.splitlines()
+        if line.startswith("keystone_request_phase_seconds_sum")
+    ]
+    assert float(sum_line.rsplit(" ", 1)[1]) == pytest.approx(0.0604)

@@ -278,3 +278,80 @@ def test_enable_tracing_preserves_recent_spans_across_resize():
     finally:
         disable_tracing()
         tr.clear()
+
+
+# -- W3C trace context (the cross-process wire format) ----------------------
+
+
+def test_traceparent_round_trips():
+    from keystone_tpu.observability.tracing import (
+        format_traceparent,
+        parse_traceparent,
+    )
+
+    tid = "0af7651916cd43dd8448eb211c80319c"
+    header = format_traceparent(tid, 0x00F067AA0BA902B7)
+    assert header == f"00-{tid}-00f067aa0ba902b7-01"
+    ctx = parse_traceparent(header)
+    assert ctx.trace_id == tid
+    assert ctx.parent_span_id == "00f067aa0ba902b7"
+    assert ctx.flags == "01"
+
+
+def test_traceparent_rejects_malformed_and_all_zero():
+    from keystone_tpu.observability.tracing import parse_traceparent
+
+    tid = "0af7651916cd43dd8448eb211c80319c"
+    bad = [
+        None,
+        "",
+        "garbage",
+        f"00-{tid}-00f067aa0ba902b7",          # missing flags
+        f"zz-{tid}-00f067aa0ba902b7-01",        # non-hex version
+        f"ff-{tid}-00f067aa0ba902b7-01",        # forbidden version
+        "00-" + "0" * 32 + "-00f067aa0ba902b7-01",  # zero trace id
+        f"00-{tid}-" + "0" * 16 + "-01",        # zero parent id
+        f"00-{tid[:30]}-00f067aa0ba902b7-01",   # short trace id
+        # version 00 defines EXACTLY four fields; trailing data means
+        # restart-the-trace, not adopt-and-ignore
+        f"00-{tid}-00f067aa0ba902b7-01-extra",
+    ]
+    for header in bad:
+        assert parse_traceparent(header) is None, header
+    # uppercase input normalizes (the spec says lowercase on the wire,
+    # receivers are lenient)
+    assert parse_traceparent(
+        f"00-{tid.upper()}-00F067AA0BA902B7-01"
+    ).trace_id == tid
+
+
+def test_start_span_adopts_explicit_trace_id():
+    """An explicit trace_id (an inbound traceparent's) roots the local
+    chain under the REMOTE trace: children inherit it through both the
+    thread stack and cross-thread parent pinning."""
+    from keystone_tpu.observability.tracing import Tracer
+
+    tr = Tracer(enabled=True)
+    tid = "ab" * 16
+    root = tr.start_span("gateway.admit", trace_id=tid)
+    assert root.trace_id == tid
+    with tr.span("inner") as inner:
+        assert inner.trace_id == tid
+        assert inner.parent_id == root.span_id
+    tr.end_span(root)
+    # cross-thread pinning joins the adopted trace too
+    pinned = tr.start_span("microbatch.coalesce", parent_id=root.span_id)
+    assert pinned.trace_id == tid
+    tr.end_span(pinned)
+    assert {s.trace_id for s in tr.spans_for_trace(tid)} == {tid}
+
+
+def test_disabled_tracer_span_accepts_trace_id():
+    from keystone_tpu.observability.tracing import Tracer
+
+    tr = Tracer(enabled=False)
+    span = tr.start_span("gateway.admit", trace_id="cd" * 16)
+    assert span.trace_id is None  # the shared null span records nothing
+    with tr.span("x", trace_id="cd" * 16) as s:
+        assert s.trace_id is None
+    assert tr.recent() == []
