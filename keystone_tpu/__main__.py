@@ -167,6 +167,21 @@ def main(argv=None) -> int:
               "tail arrivals, arms fault points mid-run via /chaosz, "
               "and exits nonzero unless the serving invariants held; "
               "keystone_tpu/loadgen/)")
+        print("  serve-autoscale  (autonomous fleet elasticity: an "
+              "in-process fleet router + a supervisor spawning "
+              "serve-gateway replicas as subprocesses + an SLO-driven "
+              "control loop — scrapes the router's federated /metrics "
+              "+ /slz, scales out when queue_wait-dominated latency "
+              "burns the SLO, replaces kill -9'd replicas, and "
+              "drain-retires idle ones; every decision is a JSON "
+              "event, keystone_autoscale_* series, and a trace span; "
+              "keystone_tpu/autoscale/)")
+        print("  serve-capacity-plan  (replay a recorded --request-log "
+              "peak x1..xN against 1..K supervised replicas, fit the "
+              "replicas-vs-offered-load curve, and write the JSON "
+              "plan artifact serve-autoscale --plan loads — the "
+              "policy thresholds are measured, not guessed; "
+              "keystone_tpu/autoscale/planner.py)")
         print("  serve-aot-build  (pre-populate the AOT serialized-"
               "executable store: compile every bucket once and "
               "serialize the executables so a brand-new host's "
@@ -239,6 +254,14 @@ def main(argv=None) -> int:
         from keystone_tpu.loadgen.cli import main as serve_loadgen_main
 
         return serve_loadgen_main(argv[1:])
+    if app == "serve-autoscale":
+        from keystone_tpu.autoscale.cli import main as serve_autoscale_main
+
+        return serve_autoscale_main(argv[1:])
+    if app == "serve-capacity-plan":
+        from keystone_tpu.autoscale.planner import main as capacity_plan_main
+
+        return capacity_plan_main(argv[1:])
     if app == "serve-aot-build":
         from keystone_tpu.serving.aot import build_main
 

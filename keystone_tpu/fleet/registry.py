@@ -299,6 +299,23 @@ class ReplicaRegistry:
         )
         return replica, True
 
+    def remove(self, url: str) -> bool:
+        """Drop one replica from the roster (idempotent by URL) — the
+        ``POST /deregisterz`` half of graceful retirement: once
+        removed, ``pick()`` can never hand the replica new forwards,
+        so it can drain its in-flight work and exit without lingering
+        in the roster until probes fail it. Returns whether the URL
+        was a member."""
+        url = _validate_replica_url(url)
+        with self._lock:
+            replica = self._replicas.pop(url, None)
+        if replica is not None:
+            logger.info(
+                "fleet %s: replica %s deregistered (index %d)",
+                self.name, replica.name, replica.index,
+            )
+        return replica is not None
+
     def replicas(self) -> List[Replica]:
         with self._lock:
             return list(self._replicas.values())

@@ -26,6 +26,13 @@ gateway frontend (``observability/httpd.py``). Routes:
 - ``POST /registerz`` — ``{"url": "http://host:port"}``
   self-registration (what ``serve-gateway --register`` POSTs at
   startup); idempotent per URL, so re-registration is a heartbeat.
+- ``POST /deregisterz`` — ``{"url": "http://host:port"}`` roster
+  REMOVAL (idempotent): no new forwards land on the replica from the
+  moment this returns, which is the first step of graceful
+  retirement — the autoscale supervisor (and a draining
+  ``serve-gateway`` itself, on SIGTERM) deregisters, drains
+  in-flight work, then exits, instead of lingering in the roster
+  until probes fail it.
 - ``GET /fleetz`` — the JSON roster: per-replica health state
   (healthy / half-open / unhealthy / unreachable), readiness + the
   burn-state body, load, build info, failure forensics.
@@ -59,7 +66,9 @@ gateway frontend (``observability/httpd.py``). Routes:
 - ``GET|POST /chaosz`` — the fault-injection plane, identical to the
   gateway frontend's: the fleet-level points
   ``router.replica.blackhole`` (drop a matched replica's /predict
-  responses — a return-path partition) and ``router.trace.drop``
+  responses — a return-path partition), ``router.replica.partition``
+  (sever the forward BEFORE it dials — the request-path partition
+  the autoscale drill fires mid-scale-up), and ``router.trace.drop``
   (strip the traceparent off a forward — the partial-stitch drill)
   are armed HERE, in the router process, and fire on the forward
   path.
@@ -279,9 +288,9 @@ class _RouterHandler(JsonHandler):
             else:
                 self._send_text(
                     404,
-                    "not found; try /predict /registerz /fleetz "
-                    "/readyz /healthz /metrics /slz /tracez /debugz "
-                    "/chaosz\n",
+                    "not found; try /predict /registerz /deregisterz "
+                    "/fleetz /readyz /healthz /metrics /slz /tracez "
+                    "/debugz /chaosz\n",
                 )
         except Exception as e:
             logger.exception("router GET error for %s", self.path)
@@ -295,11 +304,14 @@ class _RouterHandler(JsonHandler):
                 self._predict()
             elif path == "/registerz":
                 self._registerz()
+            elif path == "/deregisterz":
+                self._deregisterz()
             elif path == "/chaosz":
                 self._chaosz()
             else:
                 self._send_text(
-                    404, "not found; try /predict /registerz /chaosz\n"
+                    404, "not found; try /predict /registerz "
+                    "/deregisterz /chaosz\n"
                 )
         except Exception as e:
             logger.exception("router POST error for %s", self.path)
@@ -553,6 +565,21 @@ class _RouterHandler(JsonHandler):
         content_type)`` for any response the client should see
         verbatim; raises ``ReplicaUnavailable`` for outcomes worth
         trying another replica for."""
+        # chaos point: an armed router.replica.partition severs the
+        # router<->replica link BEFORE the forward is even dialed —
+        # the request-path half of a network partition (the replica
+        # never sees the request, unlike blackhole's return-path
+        # drop). The retry + health machinery must absorb it exactly
+        # like a connection refusal: fail over to a sibling, charge
+        # the replica. Unarmed: one attribute read.
+        if faults.armed() and faults.fire(
+            "router.replica.partition",
+            {"replica": replica.name, "index": replica.index},
+        ) is not None:
+            raise ReplicaUnavailable(
+                "router.replica.partition severed the forward to "
+                f"{replica.name}"
+            )
         headers = {"Content-Type": "application/json"}
         if traceparent is not None:
             headers[TRACEPARENT_HEADER] = traceparent
@@ -658,6 +685,31 @@ class _RouterHandler(JsonHandler):
                 "replicas": len(self.fleet),
                 "probe_interval_s": self.fleet.probe_interval_s,
             }
+        )
+
+    def _deregisterz(self) -> None:
+        """Roster removal (idempotent): the graceful-retirement half
+        of ``/registerz``. A deregistered replica gets no new
+        forwards; in-flight forwards finish normally."""
+        try:
+            doc = json.loads(self._read_body() or b"{}")
+        except ValueError as e:
+            self._send_error_json(400, "bad_request", detail=str(e))
+            return
+        url = doc.get("url")
+        if not isinstance(url, str):
+            self._send_error_json(
+                400, "bad_request",
+                detail='want {"url": "http://host:port"}',
+            )
+            return
+        try:
+            removed = self.fleet.remove(url)
+        except ValueError as e:
+            self._send_error_json(400, "bad_request", detail=str(e))
+            return
+        self._send_json(
+            {"deregistered": removed, "replicas": len(self.fleet)}
         )
 
     def _chaosz(self) -> None:
@@ -983,8 +1035,9 @@ def main(argv=None) -> int:
     )
     print(
         f"router: {server.url()} (POST /predict, POST /registerz, "
-        "GET /fleetz, GET /readyz, GET /metrics, GET /slz, "
-        "GET /tracez, GET /debugz?trace_id=, GET|POST /chaosz)",
+        "POST /deregisterz, GET /fleetz, GET /readyz, GET /metrics, "
+        "GET /slz, GET /tracez, GET /debugz?trace_id=, "
+        "GET|POST /chaosz)",
         flush=True,
     )
     stop = threading.Event()

@@ -568,37 +568,52 @@ def register_with_router(
     own_url: str,
     attempts: int = 30,
     interval_s: float = 1.0,
+    cancel: Optional[threading.Event] = None,
 ) -> bool:
     """POST this gateway's base URL to a fleet router's ``/registerz``
     (``serve-gateway --register``). Retries: replicas and their router
     launch concurrently, so the router may not be listening yet — the
     registration is idempotent per URL, a later success is as good as
-    a first one."""
-    import urllib.request
+    a first one. ``cancel`` stops the retry loop: the DRAIN path sets
+    it before deregistering, or a straggling retry could re-register
+    a replica that is already exiting — recreating exactly the
+    lingering-roster-entry gap deregistration closes."""
+    from keystone_tpu.fleet.client import REGISTER_ROUTE, post_roster
 
-    body = json.dumps({"url": own_url.rstrip("/")}).encode("utf-8")
-    endpoint = router_url.rstrip("/") + "/registerz"
     for attempt in range(attempts):
+        if cancel is not None and cancel.is_set():
+            return False
         try:
-            req = urllib.request.Request(
-                endpoint,
-                data=body,
-                headers={"Content-Type": "application/json"},
-                method="POST",
+            post_roster(router_url, REGISTER_ROUTE, own_url, timeout_s=10)
+            logger.info(
+                "registered %s with router %s", own_url, router_url
             )
-            with urllib.request.urlopen(req, timeout=10):
-                logger.info(
-                    "registered %s with router %s", own_url, router_url
-                )
-                return True
+            return True
         except Exception as e:
             if attempt == attempts - 1:
                 logger.warning(
                     "could not register with router %s after %d "
                     "attempts: %s", router_url, attempts, e,
                 )
-            time.sleep(interval_s)
+            if cancel is not None:
+                if cancel.wait(interval_s):
+                    return False
+            else:
+                time.sleep(interval_s)
     return False
+
+
+def deregister_from_router(router_url: str, own_url: str) -> bool:
+    """POST this gateway's base URL to a fleet router's
+    ``/deregisterz`` — the exit half of ``register_with_router``,
+    called once the drain has finished so a retired replica leaves
+    the roster instead of lingering until probes fail it. ONE short
+    attempt (``fleet/client.try_deregister``): a dead router must not
+    stall a process exit, unlike startup registration which is
+    allowed to wait for a router still binding."""
+    from keystone_tpu.fleet.client import try_deregister
+
+    return try_deregister(router_url, own_url, timeout_s=3.0)
 
 
 def main(argv=None) -> int:
@@ -754,11 +769,15 @@ def main(argv=None) -> int:
         flush=True,
     )
     advertised = args.advertise_url or server.url()
+    # set on drain, BEFORE deregistering: a registration retry that
+    # outlives the drain must not re-add this replica to the roster
+    cancel_registration = threading.Event()
     for router_url in args.register:
         # background: registration retries must not delay serving
         threading.Thread(
             target=register_with_router,
             args=(router_url, advertised),
+            kwargs={"cancel": cancel_registration},
             name="keystone-gateway-register",
             daemon=True,
         ).start()
@@ -767,6 +786,16 @@ def main(argv=None) -> int:
             time.sleep(0.5)
     except KeyboardInterrupt:
         pass
+    # the graceful-exit protocol, in order: stop any registration
+    # retries (a straggler would re-register a dying replica), finish
+    # the drain (stop admitting, resolve in-flight windows), THEN
+    # deregister from every router this replica joined — the roster
+    # entry outliving the drain is harmless (the router fails over on
+    # 503-closed), the reverse order would drop the roster entry
+    # while work is still in flight behind it
+    cancel_registration.set()
     gateway.close()
+    for router_url in args.register:
+        deregister_from_router(router_url, advertised)
     server.stop()
     return 0

@@ -79,6 +79,14 @@ def build_parser() -> argparse.ArgumentParser:
                     "originating POSTs")
     wl.add_argument("--synthetic", type=int, default=None, metavar="N",
                     help="synthesize N requests instead of --trace")
+    wl.add_argument("--ramp", default=None, metavar="RATE:DUR,...",
+                    help="synthesize a STEP/RAMP offered-load shape "
+                    "instead of --trace/--synthetic: comma-separated "
+                    "rate:duration_s steps (e.g. '5:4,40:8,5:6' = 4s "
+                    "at 5 rps, an 8s surge at 40 rps, 6s back at 5) "
+                    "— the deterministic load staircase the "
+                    "autoscale/capacity drills use; --arrivals names "
+                    "the within-step process")
     wl.add_argument("--arrivals", default="poisson",
                     choices=trace_mod.ARRIVALS)
     wl.add_argument("--rate", type=float, default=100.0,
@@ -134,32 +142,51 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
-def _build_events(args) -> List[trace_mod.TraceEvent]:
-    if (args.trace is None) == (args.synthetic is None):
+def build_workload(args) -> List[trace_mod.TraceEvent]:
+    """One workload builder for every replaying CLI (``serve-loadgen``
+    AND ``serve-capacity-plan``): exactly one of ``--trace FILE``,
+    ``--synthetic N``, or ``--ramp RATE:DUR,...`` becomes the event
+    list. Reads optional shaping flags (``sigma``/``alpha``/
+    ``deadline_sigma``/``no_collapse``) off the namespace when the
+    caller's parser defines them, library defaults otherwise — so the
+    two CLIs can't drift apart on what a workload spec means."""
+    trace = getattr(args, "trace", None)
+    synthetic = getattr(args, "synthetic", None)
+    ramp = getattr(args, "ramp", None)
+    chosen = sum(x is not None for x in (trace, synthetic, ramp))
+    if chosen != 1:
         raise SystemExit(
-            "pass exactly one of --trace FILE or --synthetic N"
+            "pass exactly one of --trace FILE, --synthetic N, or "
+            "--ramp RATE:DUR,..."
         )
-    if args.trace is not None:
+    if trace is not None:
         events = trace_mod.load_trace(
-            args.trace, collapse=not args.no_collapse
+            trace, collapse=not getattr(args, "no_collapse", False)
         )
         if not events:
             raise SystemExit(
-                f"--trace {args.trace}: no replayable records found"
+                f"--trace {trace}: no replayable records found"
             )
         return events
-    return trace_mod.synthesize(
-        args.synthetic,
+    shaping = dict(
         arrivals=args.arrivals,
-        rate=args.rate,
-        sigma=args.sigma,
-        alpha=args.alpha,
+        sigma=getattr(args, "sigma", 1.0),
+        alpha=getattr(args, "alpha", 1.5),
         size_mix=trace_mod.parse_size_mix(args.size_mix),
         shape=(args.d,),
         deadline_ms=args.deadline_ms,
-        deadline_sigma=args.deadline_sigma,
+        deadline_sigma=getattr(args, "deadline_sigma", 0.0),
         seed=args.seed,
     )
+    if ramp is not None:
+        return trace_mod.synthesize_steps(
+            trace_mod.parse_steps(ramp), **shaping
+        )
+    return trace_mod.synthesize(synthetic, rate=args.rate, **shaping)
+
+
+# the historical private name (serve-loadgen's own entry point)
+_build_events = build_workload
 
 
 def _build_fault_plans(args) -> List[FaultPlan]:

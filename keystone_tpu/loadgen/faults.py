@@ -91,6 +91,17 @@ FAULT_POINTS: Dict[str, str] = {
         "absorb it (match: replica=<host:port> or index=<registration "
         "order>)"
     ),
+    "router.replica.partition": (
+        "error @ fleet/router.py _forward — the router<->replica "
+        "link is severed BEFORE the forward dials (the matched "
+        "replica never sees the request; the request-path complement "
+        "of router.replica.blackhole's return-path drop). The "
+        "router's retry-on-another-replica + replica health must "
+        "absorb it like a connection refusal — the autoscale drill "
+        "partitions a replica mid-scale-up and the loadgen verdict "
+        "must stay green (match: replica=<host:port> or "
+        "index=<registration order>)"
+    ),
     "router.trace.drop": (
         "drop @ fleet/router.py _predict — the W3C traceparent "
         "header is stripped off the matched forward, so the replica "

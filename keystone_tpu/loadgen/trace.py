@@ -30,6 +30,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import math
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -248,6 +249,22 @@ def synthesize(
     rng = np.random.default_rng(seed)
     gaps = _inter_arrivals(rng, n_requests, arrivals, rate, sigma, alpha)
     ts = np.concatenate([[0.0], np.cumsum(gaps[:-1])])
+    return _events_at(
+        rng, ts, size_mix, shape, deadline_ms, deadline_sigma
+    )
+
+
+def _events_at(
+    rng: np.random.Generator,
+    ts: np.ndarray,
+    size_mix: Sequence[Tuple[int, float]],
+    shape: Sequence[int],
+    deadline_ms: Optional[float],
+    deadline_sigma: float,
+) -> List[TraceEvent]:
+    """Dress arrival instants with sizes/deadlines — the shared tail
+    of ``synthesize`` and ``synthesize_steps``."""
+    n_requests = len(ts)
     sizes = np.asarray([int(s) for s, _ in size_mix])
     weights = np.asarray([float(w) for _, w in size_mix], np.float64)
     if (weights <= 0).any():
@@ -270,6 +287,109 @@ def synthesize(
         )
         for i in range(n_requests)
     ]
+
+
+def synthesize_steps(
+    steps: Sequence[Tuple[float, float]],
+    *,
+    arrivals: str = "poisson",
+    size_mix: Sequence[Tuple[int, float]] = ((1, 1.0),),
+    shape: Sequence[int] = (8,),
+    deadline_ms: Optional[float] = None,
+    deadline_sigma: float = 0.0,
+    sigma: float = 1.0,
+    alpha: float = 1.5,
+    seed: int = 0,
+) -> List[TraceEvent]:
+    """A STEP/RAMP offered-load shape: ``steps`` is ``[(rate,
+    duration_s), ...]`` and each step issues arrivals from the named
+    process at its own rate for its own duration — the deterministic
+    load staircase the scale-out drills and the capacity planner
+    script against a fleet (a ramp is just many small steps). A
+    ``(0, duration)`` step is a silence — the idle tail a scale-down
+    drill needs. Deterministic per seed, like ``synthesize``.
+
+    The open-loop replayer treats the result identically to any
+    other trace: arrivals on the generator's clock, never paced by
+    responses, so the high step genuinely overloads an under-scaled
+    fleet."""
+    if not steps:
+        raise ValueError("synthesize_steps needs at least one step")
+    expected = sum(
+        float(rate) * float(dur) for rate, dur in steps
+        if math.isfinite(float(rate)) and math.isfinite(float(dur))
+    )
+    if expected > 2_000_000:
+        # --synthetic bounds the event count explicitly; the
+        # staircase must too — a typo'd rate must fail loud, not
+        # allocate the host away before the replay starts
+        raise ValueError(
+            f"steps {list(steps)} expect ~{expected:.0f} arrivals; "
+            "bound the workload under 2e6 events"
+        )
+    rng = np.random.default_rng(seed)
+    ts: List[float] = []
+    t0 = 0.0
+    for rate, duration_s in steps:
+        rate, duration_s = float(rate), float(duration_s)
+        if not math.isfinite(duration_s) or duration_s <= 0:
+            raise ValueError(
+                f"step durations must be finite and > 0, got "
+                f"{duration_s}"
+            )
+        if not math.isfinite(rate) or rate < 0:
+            raise ValueError(
+                f"step rates must be finite and >= 0, got {rate}"
+            )
+        if rate > 0:
+            # draw in generously-sized batches until the step is
+            # covered (heavy-tail processes can exhaust a single
+            # batch before the step's clock runs out) — the sequence
+            # of draws is still seeded-deterministic
+            expect = max(1, int(rate * duration_s))
+            draw = expect + max(8, int(4 * math.sqrt(expect)))
+            t = t0
+            end = t0 + duration_s
+            while t < end:
+                gaps = _inter_arrivals(
+                    rng, draw, arrivals, rate, sigma, alpha
+                )
+                for gap in gaps:
+                    t += float(gap)
+                    if t >= end:
+                        break
+                    ts.append(t)
+        t0 += duration_s
+    if not ts:
+        raise ValueError(
+            f"steps {list(steps)} produced no arrivals (rates too "
+            "low for their durations)"
+        )
+    return _events_at(
+        rng,
+        np.asarray(ts),
+        size_mix,
+        shape,
+        deadline_ms,
+        deadline_sigma,
+    )
+
+
+def parse_steps(spec: str) -> List[Tuple[float, float]]:
+    """CLI step spec ``"rate:duration,..."`` (e.g. ``"5:4,40:8,5:6"``:
+    4 s at 5 rps, 8 s at 40 rps, 6 s back at 5 rps) ->
+    ``[(rate, duration_s), ...]`` for ``synthesize_steps``."""
+    steps = []
+    for part in spec.split(","):
+        rate, sep, duration = part.partition(":")
+        if not sep:
+            raise ValueError(
+                f"bad step entry {part!r} (want rate:duration_s)"
+            )
+        steps.append((float(rate), float(duration)))
+    if not steps:
+        raise ValueError("empty step spec")
+    return steps
 
 
 def parse_size_mix(spec: str) -> List[Tuple[int, float]]:
@@ -324,6 +444,8 @@ __all__ = [
     "parse_request_log",
     "parse_request_log_line",
     "parse_size_mix",
+    "parse_steps",
     "summarize",
     "synthesize",
+    "synthesize_steps",
 ]

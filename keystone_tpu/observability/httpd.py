@@ -93,6 +93,19 @@ class JsonHandler(BaseHTTPRequestHandler):
         logger.debug("%s: " + format, type(self).__module__, *args)
 
 
+class _QueueingHTTPServer(ThreadingHTTPServer):
+    """``ThreadingHTTPServer`` with a real listen backlog.
+    socketserver's default ``request_queue_size`` of 5 drops bursty
+    connection attempts with a client-side connection reset the
+    moment more arrive in one scheduler quantum than ``accept()``
+    drains — which the open-loop load generator at fleet rates (and
+    a router fanning out to replicas) does routinely. A reset on an
+    otherwise-healthy endpoint would be indistinguishable from a
+    LOST request to the invariant checker."""
+
+    request_queue_size = 128
+
+
 class BackgroundServer:
     """A ``ThreadingHTTPServer`` + daemon serve thread behind
     ``start()``/``stop()``. Subclasses set ``handler_cls`` and
@@ -127,7 +140,7 @@ class BackgroundServer:
     def start(self) -> "BackgroundServer":
         if self._httpd is not None:
             return self
-        httpd = ThreadingHTTPServer(self._requested, self.handler_cls)
+        httpd = _QueueingHTTPServer(self._requested, self.handler_cls)
         httpd.daemon_threads = True
         self._configure(httpd)
         self._httpd = httpd

@@ -59,6 +59,18 @@
   comes from the ROUTER'S OWN federated ``/metrics`` — per-replica
   ``le`` buckets merged by ``prometheus.merge_histograms`` — so the
   row proves the federation surface, not a bench-local stopwatch.
+- ``serving_autoscale_ramp`` — the elasticity row (``--autoscale``/
+  ``--autoscale-only``; run by ``bin/smoke-autoscale.sh``): a
+  step-load ramp (low → ~3x-one-replica surge → low, rates calibrated
+  to the host) through a live ``keystone_tpu/autoscale/`` control
+  loop — router + supervisor + SLO-driven policy over in-process
+  replicas — with ``router.replica.partition`` severing the original
+  replica mid-scale-up. Asserted: the fleet scales out (>= 2
+  replicas), the loadgen invariant verdict stays green (nothing
+  lost, typed sheds only, p99 recovers after the partition clears),
+  the partition actually fired, and the fleet drain-retires back to
+  the 1-replica baseline once the load drops. Headline: the
+  recovered post-fault p99.
 
 Callable standalone (``python -m keystone_tpu serve-bench``) or from
 the repo-level ``bench.py`` which passes its own ``emit`` so rows land
@@ -1337,6 +1349,323 @@ def bench_router_trace_overhead(
     )
 
 
+def bench_autoscale_ramp(
+    emit, fitted, buckets: Sequence[int], d: int,
+    max_replicas: int = 3,
+) -> None:
+    """``serving_autoscale_ramp`` — the elasticity acceptance row: a
+    ``RouterServer`` + the ``keystone_tpu/autoscale/`` supervisor and
+    control loop over in-process replicas (the subprocess path is the
+    smoke script's; this row exercises the identical policy/
+    supervisor/scraper machinery without paying a JAX import per
+    replica), driven by a STEP-LOAD RAMP (``synthesize_steps``):
+    a low baseline, a surge calibrated to ~3x one replica's measured
+    capacity, and a drop back to baseline. Mid-surge — mid-SCALE-UP —
+    the ``router.replica.partition`` chaos point severs the original
+    replica's forwards for ~1.2 s.
+
+    Asserted (raises, not asserts — ``python -O`` must not strip the
+    acceptance contract):
+
+    - the fleet SCALES OUT (>= 2 replicas seen) and back DOWN to the
+      1-replica baseline once the load drops (drain-based retirement);
+    - the loadgen invariant verdict is GREEN across the whole run:
+      every admitted request resolves, failures are typed sheds only,
+      readiness holds, p99 recovers after the partition clears;
+    - the partition actually fired (a chaos leg that never fired
+      proved nothing).
+
+    Rates and the SLO threshold are CALIBRATED against a measured
+    sequential baseline latency so the surge genuinely overloads one
+    replica on any host speed — a fixed rate would be a no-op on a
+    fast box and a massacre on a slow one. One bounded in-row retry
+    (the smoke-chaos doctrine): the recovery clock races the host
+    scheduler on a loaded 2-core CI box."""
+    import urllib.request
+
+    import jax.numpy as jnp
+
+    from keystone_tpu.autoscale.controller import (
+        Autoscaler,
+        RouterScraper,
+    )
+    from keystone_tpu.autoscale.policy import PolicyConfig, PolicyEngine
+    from keystone_tpu.autoscale.supervisor import (
+        InprocLauncher,
+        Supervisor,
+    )
+    from keystone_tpu.fleet import RouterServer
+    from keystone_tpu.gateway import Gateway, GatewayServer
+    from keystone_tpu.loadgen import faults
+    from keystone_tpu.loadgen.invariants import InvariantChecker
+    from keystone_tpu.loadgen.runner import (
+        FaultPlan,
+        HttpTarget,
+        LoadGenerator,
+    )
+    from keystone_tpu.loadgen.trace import synthesize_steps
+    from keystone_tpu.observability import tracing
+    from keystone_tpu.observability.registry import MetricsRegistry
+
+    point = "router.replica.partition"
+    # requests carry a full bucket of rows so coalescing cannot
+    # multiply one replica's capacity past the calibration below —
+    # the surge must genuinely overload exactly one replica
+    n_rows = min(buckets)
+
+    def run_once(attempt: int):
+        tracer = tracing.get_tracer()
+        was_enabled = tracer.enabled
+        # phase evidence (the policy's queue_wait-vs-device veto) and
+        # the autoscale.decision spans both ride the tracer
+        tracing.enable_tracing()
+        fired_before = faults.get_injector().fired_count(point)
+        router = RouterServer(
+            [], port=0, name=f"bench-autoscale-{attempt}",
+            registry=MetricsRegistry(),
+            probe_interval_s=0.25,
+            recovery_after_s=1.0,
+        ).start()
+
+        def factory(index: int):
+            reg = MetricsRegistry()
+            gw = Gateway(
+                fitted, buckets=buckets, n_lanes=1, max_delay_ms=2.0,
+                warmup_example=jnp.zeros((d,), jnp.float32),
+                name=f"bench-as{attempt}-r{index}", registry=reg,
+            )
+            srv = GatewayServer(gw, port=0, registry=reg).start()
+            return gw, srv
+
+        supervisor = Supervisor(
+            InprocLauncher(factory),
+            router.url(),
+            startup_timeout_s=60.0,
+            drain_timeout_s=15.0,
+        )
+        autoscaler = None
+        try:
+            supervisor.scale_to(1)
+            for _ in range(40):  # don't race the first probe tick
+                router.fleet.probe_once()
+                if any(
+                    r.ready and r.healthy
+                    for r in router.fleet.replicas()
+                ):
+                    break
+                time.sleep(0.25)
+
+            # -- calibration: one replica's sequential service time --
+            body = json.dumps(
+                {"instances": [[0.1] * d] * n_rows}
+            ).encode("utf-8")
+
+            def one() -> float:
+                req = urllib.request.Request(
+                    router.url("/predict"), data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                t0 = time.perf_counter()
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    resp.read()
+                return time.perf_counter() - t0
+
+            for _ in range(3):
+                one()  # warm both hops
+            lat = sorted(one() for _ in range(8))
+            base_s = lat[len(lat) // 2]
+            # the surge must EXCEED one replica's capacity on any
+            # host speed: 4x the sequential rate, with the client's
+            # outstanding bound (64 below) guaranteeing a deep queue
+            # — and the SLO sits at 5x the unloaded baseline, far
+            # under what a saturated replica's queue produces but
+            # comfortably above the baseline's scheduler noise
+            capacity_rps = 1.0 / max(base_s, 1e-3)
+            low_rate = min(8.0, max(1.0, 0.1 * capacity_rps))
+            high_rate = min(300.0, max(10.0, 4.0 * capacity_rps))
+            slo_s = max(0.03, 5.0 * base_s)
+
+            engine = PolicyEngine(PolicyConfig(
+                min_replicas=1,
+                max_replicas=max_replicas,
+                slo_latency_s=slo_s,
+                up_consecutive=2,
+                down_consecutive=4,
+                up_cooldown_s=2.0,
+                down_cooldown_s=2.0,
+                down_p99_headroom=0.5,
+            ))
+            autoscaler = Autoscaler(
+                supervisor,
+                RouterScraper(
+                    router.url(), p99_window_s=3.0,
+                    phase_samples_per_tick=2,
+                ),
+                engine,
+                interval_s=0.5,
+                registry=router.registry,
+                name=f"bench-autoscale-{attempt}",
+            ).start()
+
+            # low 4s -> surge 10s -> low 10s; the partition severs
+            # the ORIGINAL replica (index 0) mid-surge, mid-scale-up
+            steps = [
+                (low_rate, 4.0), (high_rate, 10.0), (low_rate, 10.0),
+            ]
+            events = synthesize_steps(
+                steps, arrivals="poisson", shape=(d,),
+                size_mix=((n_rows, 1.0),), seed=29,
+            )
+            gen = LoadGenerator(
+                HttpTarget(router.url(), default_shape=(d,)),
+                max_outstanding=64,
+            )
+            report = gen.run(
+                events,
+                faults=[FaultPlan(
+                    spec={"point": point, "match": {"index": 0}},
+                    at_s=9.0, for_s=1.2,
+                )],
+                settle_s=6.0,
+                recovery_probe_s=10.0,
+            )
+            verdict = InvariantChecker(
+                p99_factor=2.0, recovery_within_s=12.0,
+                max_shed_rate=0.9,
+            ).check(report)
+            injections = (
+                faults.get_injector().fired_count(point) - fired_before
+            )
+
+            # scale-down back to baseline: the load is gone, the
+            # cold streak + cooldowns need a few more ticks
+            deadline = time.perf_counter() + 25.0
+            while (
+                supervisor.target > 1
+                and time.perf_counter() < deadline
+            ):
+                time.sleep(0.5)
+            final_target = supervisor.target
+            max_seen = autoscaler.max_replicas_seen
+            decisions = [
+                (d2.action, d2.reason)
+                for d2 in autoscaler.decisions
+                if d2.action != "hold"
+            ]
+            up_count = autoscaler.metrics.decision_count("scale_up")
+            down_count = autoscaler.metrics.decision_count("scale_down")
+        finally:
+            if autoscaler is not None:
+                autoscaler.stop()
+            supervisor.stop()
+            router.stop()
+            tracer.enabled = was_enabled
+        return {
+            "verdict": verdict,
+            "report": report,
+            "injections": injections,
+            "max_seen": max_seen,
+            "final_target": final_target,
+            "decisions": decisions,
+            "up_count": up_count,
+            "down_count": down_count,
+            "base_ms": base_s * 1e3,
+            "slo_ms": slo_s * 1e3,
+            "low_rate": low_rate,
+            "high_rate": high_rate,
+        }
+
+    last_error = None
+    for attempt in (1, 2):
+        try:
+            r = run_once(attempt)
+        except Exception as e:
+            if attempt == 1:
+                # a host stall mid-calibration (or mid-drill) gets
+                # the same single fresh chance a red verdict does
+                last_error = f"attempt 1 raised {type(e).__name__}: {e}"
+                continue
+            raise
+        problems = []
+        if r["injections"] <= 0:
+            problems.append(
+                f"{point} never fired — the chaos leg proved nothing"
+            )
+        if not r["verdict"].passed:
+            problems.append(
+                "serving invariants violated:\n" + r["verdict"].to_json()
+            )
+        if r["max_seen"] < 2:
+            problems.append(
+                f"fleet never scaled out (max {r['max_seen']} replica)"
+            )
+        if r["final_target"] != 1:
+            problems.append(
+                "fleet did not scale back down to the 1-replica "
+                f"baseline (final target {r['final_target']})"
+            )
+        if not problems:
+            break
+        last_error = "; ".join(problems)
+        if attempt == 1:
+            # host-load flake guard: one fresh experiment, same
+            # bounded-retry doctrine as the other chaos/fleet rows
+            continue
+        raise RuntimeError(
+            f"serving_autoscale_ramp failed on both attempts: "
+            f"{last_error}"
+        )
+    stats = r["verdict"].stats
+    emit(
+        "serving_autoscale_ramp",
+        stats.get("recovered_p99_ms") or stats.get("post_fault_p99_ms"),
+        "ms",
+        extra={
+            "verdict": "green",
+            "invariants": [x.name for x in r["verdict"].invariants],
+            "fault": f"{point} index=0 for 1.2s mid-surge",
+            "injections": r["injections"],
+            "max_replicas_seen": r["max_seen"],
+            "final_target": r["final_target"],
+            "scale_ups": r["up_count"],
+            "scale_downs": r["down_count"],
+            "decisions": r["decisions"],
+            "calibrated_baseline_ms": round(r["base_ms"], 2),
+            "slo_ms": round(r["slo_ms"], 2),
+            "ramp_rps": [round(r["low_rate"], 1),
+                         round(r["high_rate"], 1),
+                         round(r["low_rate"], 1)],
+            "requests": stats["issued"],
+            "resolved": stats["resolved"],
+            "untyped_failures": stats["untyped_failures"],
+            "lost": stats["lost"],
+            "shed_rate": stats["shed_rate"],
+            "pre_fault_p99_ms": stats.get("pre_fault_p99_ms"),
+            "during_fault_p99_ms": stats.get("during_fault_p99_ms"),
+            "recovered_p99_ms": stats.get("recovered_p99_ms"),
+        },
+    )
+
+
+def run_autoscale_benches(
+    emit,
+    d: int = 64,
+    hidden: int = 256,
+    depth: int = 3,
+    buckets: Sequence[int] = (8, 16),
+    fitted=None,
+) -> None:
+    """The elasticity row (~45 s of ramped load through a live
+    autoscaler; run by ``bin/smoke-autoscale.sh``). Deliberately a
+    smaller pipeline than the default bench shape: the row measures
+    the CONTROL LOOP, and per-replica warmup compile time directly
+    stretches the scale-up reaction it asserts on."""
+    if fitted is None:
+        fitted = build_pipeline(d, hidden, depth)
+    bench_autoscale_ramp(emit, fitted, buckets, d)
+
+
 def run_fleet_benches(
     emit,
     d: int = 256,
@@ -1369,6 +1698,7 @@ def run_serving_benches(
     chaos: bool = False,
     cold_start: bool = True,
     fleet: bool = False,
+    autoscale: bool = False,
 ) -> None:
     fitted = build_pipeline(d, hidden, depth)
     bench_cold_vs_warm(emit, fitted, buckets, d)
@@ -1409,6 +1739,11 @@ def run_serving_benches(
     if fleet:
         run_fleet_benches(emit, d=d, hidden=hidden, depth=depth,
                           buckets=buckets, fitted=fitted)
+    if autoscale:
+        # its own (smaller) pipeline: scale-up reaction time includes
+        # per-replica warmup, which the default bench shape would
+        # stretch past the drill's ramp timings
+        run_autoscale_benches(emit)
 
 
 def run_chaos_benches(
@@ -1486,6 +1821,17 @@ def main(argv=None) -> int:
                     "(bin/smoke-fleet.sh runs failover and trace in "
                     "separate processes so each retries alone and "
                     "the tracing A/B measures a quiet process)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="also run the elasticity row "
+                    "(serving_autoscale_ramp): a step-load ramp "
+                    "through a live router + autoscale control loop "
+                    "over in-process replicas, with "
+                    "router.replica.partition fired mid-scale-up — "
+                    "scale-out, green verdict, and drain-based "
+                    "scale-down all asserted (~45s)")
+    ap.add_argument("--autoscale-only", action="store_true",
+                    help="run ONLY the elasticity row (what "
+                    "bin/smoke-autoscale.sh invokes)")
     ap.add_argument("--no-cold-start", action="store_true",
                     help="skip the serving_cold_start_aot row (it "
                     "spawns fresh gateway subprocesses and takes "
@@ -1515,7 +1861,9 @@ def main(argv=None) -> int:
         print(json.dumps(row), flush=True)
 
     def run():
-        if args.fleet_only:
+        if args.autoscale_only:
+            run_autoscale_benches(emit)
+        elif args.fleet_only:
             run_fleet_benches(
                 emit, d=args.d, hidden=args.hidden, depth=args.depth,
                 buckets=buckets, rows=args.fleet_rows,
@@ -1531,6 +1879,7 @@ def main(argv=None) -> int:
                 buckets=buckets, chaos=args.chaos,
                 cold_start=not args.no_cold_start,
                 fleet=args.fleet,
+                autoscale=args.autoscale,
             )
 
     if args.profile_dir:

@@ -1,0 +1,93 @@
+"""Capacity-planner unit tests: the curve fit, the artifact shape,
+and the plan -> ``PolicyConfig`` round trip (the measured-not-guessed
+path). The full replay grid is exercised by the CLI against a live
+fleet; here the arithmetic is pinned."""
+
+import json
+
+import pytest
+
+from keystone_tpu.autoscale.planner import (
+    build_artifact,
+    derive_policy,
+    fit_capacity,
+)
+from keystone_tpu.autoscale.policy import PolicyConfig
+
+
+def test_fit_capacity_least_squares_through_origin():
+    # a perfectly linear fleet: capacity(k) = 50k
+    assert fit_capacity({1: 50.0, 2: 100.0, 3: 150.0}) == pytest.approx(50.0)
+    # sub-linear scaling pulls the slope down, never up
+    slope = fit_capacity({1: 50.0, 2: 80.0})
+    assert slope < 50.0
+    # zero-capacity cells (never held the SLO) don't drag the fit
+    assert fit_capacity({1: 50.0, 2: 0.0}) == pytest.approx(50.0)
+    assert fit_capacity({1: 0.0}) is None
+    assert fit_capacity({}) is None
+
+
+def test_derive_policy_fields():
+    policy = derive_policy(42.0, 0.25, target_utilization=0.6)
+    assert policy == {
+        "slo_latency_s": 0.25,
+        "target_utilization": 0.6,
+        "per_replica_rps": 42.0,
+    }
+    assert "per_replica_rps" not in derive_policy(None, 0.25)
+
+
+def _rows():
+    return [
+        {"replicas": 1, "speed": 1.0, "offered_rps": 20.0,
+         "p99_ms": 30.0, "shed_rate": 0.0, "lost": 0, "errors": 0,
+         "slo_held": True},
+        {"replicas": 1, "speed": 2.0, "offered_rps": 40.0,
+         "p99_ms": 900.0, "shed_rate": 0.2, "lost": 0, "errors": 0,
+         "slo_held": False},
+        {"replicas": 2, "speed": 2.0, "offered_rps": 40.0,
+         "p99_ms": 35.0, "shed_rate": 0.0, "lost": 0, "errors": 0,
+         "slo_held": True},
+    ]
+
+
+def test_build_artifact_capacity_is_best_held_cell():
+    artifact = build_artifact(_rows(), 0.25, 0.99)
+    assert artifact["capacity_rps_by_replicas"] == {
+        "1": 20.0, "2": 40.0,
+    }
+    assert artifact["fit"]["per_replica_rps"] == pytest.approx(20.0)
+    assert artifact["policy"]["per_replica_rps"] == pytest.approx(20.0)
+    assert artifact["slo"]["latency_s"] == 0.25
+
+
+def test_artifact_round_trips_into_policy_config(tmp_path):
+    artifact = build_artifact(_rows(), 0.25, 0.99)
+    path = tmp_path / "plan.json"
+    path.write_text(json.dumps(artifact))
+    cfg = PolicyConfig.from_plan(str(path), max_replicas=6)
+    assert cfg.per_replica_rps == pytest.approx(20.0)
+    assert cfg.slo_latency_s == 0.25
+    assert cfg.target_utilization == 0.7
+    assert cfg.max_replicas == 6  # explicit overrides win
+
+
+def test_from_plan_rejects_junk():
+    with pytest.raises(ValueError, match="dict artifact"):
+        PolicyConfig.from_plan([1, 2])
+    with pytest.raises(ValueError, match="unknown policy fields"):
+        PolicyConfig.from_plan({"policy": {"warp_factor": 9}})
+
+
+def test_from_plan_overrides_win_over_derived():
+    plan = {
+        "slo": {"latency_s": 0.5},
+        "fit": {"per_replica_rps": 10.0},
+        "policy": {"target_utilization": 0.9},
+    }
+    cfg = PolicyConfig.from_plan(
+        plan, slo_latency_s=0.2, per_replica_rps=33.0
+    )
+    assert cfg.slo_latency_s == 0.2
+    assert cfg.per_replica_rps == 33.0
+    assert cfg.target_utilization == 0.9

@@ -1,0 +1,246 @@
+"""PolicyEngine unit tests: the hysteresis/cooldown/veto arithmetic
+on synthetic observations — the edge cases a live fleet would need
+hours of flapping load to reproduce."""
+
+import pytest
+
+from keystone_tpu.autoscale.policy import (
+    FleetObservation,
+    PolicyConfig,
+    PolicyEngine,
+    phase_shares,
+)
+
+
+def cfg(**kw):
+    base = dict(
+        min_replicas=1,
+        max_replicas=4,
+        slo_latency_s=0.1,
+        up_burn=1.5,
+        down_burn=0.5,
+        up_consecutive=2,
+        down_consecutive=3,
+        up_cooldown_s=5.0,
+        down_cooldown_s=5.0,
+        down_p99_headroom=0.5,
+    )
+    base.update(kw)
+    return PolicyConfig(**base)
+
+
+def obs(t, p99=None, burn=None, metrics_ok=True, **kw):
+    # metrics_ok defaults True here: these are ticks whose scrape
+    # SUCCEEDED (the blind-scrape case is tested explicitly)
+    return FleetObservation(
+        t=float(t), fleet_p99_s=p99, burn_fast=burn,
+        metrics_ok=metrics_ok, **kw
+    )
+
+
+HOT = dict(p99=0.5)    # far over the 100ms objective
+COLD = dict(p99=0.01)  # far inside the 50ms headroom band
+
+
+# -- scale-up ---------------------------------------------------------------
+
+
+def test_scale_up_needs_consecutive_hot_ticks():
+    e = PolicyEngine(cfg())
+    assert e.decide(1, obs(0, **HOT)).action == "hold"
+    d = e.decide(1, obs(1, **HOT))
+    assert d.action == "scale_up" and d.target == 2
+    assert d.reason == "slo_pressure"
+
+
+def test_burn_rate_alone_trips_hot():
+    e = PolicyEngine(cfg())
+    e.decide(1, obs(0, burn=2.0))
+    d = e.decide(1, obs(1, burn=2.0))
+    assert d.action == "scale_up" and d.reason == "burn_rate"
+
+
+def test_flapping_at_the_threshold_never_oscillates():
+    """Alternating hot/cold ticks forever: neither streak can reach
+    its consecutive count, so the fleet must never move."""
+    e = PolicyEngine(cfg())
+    for i in range(40):
+        d = e.decide(2, obs(i, **(HOT if i % 2 == 0 else COLD)))
+        assert d.action == "hold", (i, d)
+
+
+def test_in_band_ticks_reset_both_streaks():
+    e = PolicyEngine(cfg())
+    e.decide(1, obs(0, **HOT))
+    # 60ms: over the 50ms cold headroom, under the 100ms objective —
+    # the dead band
+    assert e.decide(1, obs(1, p99=0.06)).reason == "in_band"
+    # the earlier hot tick must not still count
+    assert e.decide(1, obs(2, **HOT)).action == "hold"
+
+
+def test_up_cooldown_blocks_back_to_back_scale_ups():
+    e = PolicyEngine(cfg())
+    e.decide(1, obs(0, **HOT))
+    assert e.decide(1, obs(1, **HOT)).action == "scale_up"
+    e.decide(2, obs(2, **HOT))
+    d = e.decide(2, obs(3, **HOT))
+    assert d.action == "hold" and d.reason == "up_cooldown"
+    # cooldown elapsed: the (still-sustained) burn acts immediately
+    assert e.decide(2, obs(7, **HOT)).action == "scale_up"
+
+
+def test_max_replicas_bounds_scale_up():
+    e = PolicyEngine(cfg(max_replicas=2))
+    e.decide(2, obs(0, **HOT))
+    d = e.decide(2, obs(1, **HOT))
+    assert d.action == "hold" and d.reason == "at_max_replicas"
+
+
+def test_device_bound_latency_vetoes_scale_up():
+    """A device-dominated phase decomposition means more replicas
+    cannot help — the one veto that outranks a burning SLO."""
+    e = PolicyEngine(cfg())
+    shares = {"device": 0.7, "queue_wait": 0.2, "deliver": 0.1}
+    e.decide(1, obs(0, phase_shares=shares, **HOT))
+    d = e.decide(1, obs(1, phase_shares=shares, **HOT))
+    assert d.action == "hold" and d.reason == "device_bound"
+
+
+def test_queue_wait_dominated_latency_scales_up():
+    e = PolicyEngine(cfg())
+    shares = {"device": 0.2, "queue_wait": 0.6, "coalesce": 0.2}
+    e.decide(1, obs(0, phase_shares=shares, **HOT))
+    assert (
+        e.decide(1, obs(1, phase_shares=shares, **HOT)).action
+        == "scale_up"
+    )
+
+
+def test_absent_phase_evidence_does_not_veto():
+    e = PolicyEngine(cfg())
+    e.decide(1, obs(0, **HOT))
+    assert e.decide(1, obs(1, **HOT)).action == "scale_up"
+
+
+def test_capacity_plan_feeds_forward_past_one_step():
+    """With a fitted per-replica rate, a big load step jumps straight
+    to the replica count the curve says it needs."""
+    e = PolicyEngine(cfg(per_replica_rps=10.0, target_utilization=0.5))
+    e.decide(1, obs(0, offered_rps=20.0, **HOT))
+    d = e.decide(1, obs(1, offered_rps=20.0, **HOT))
+    # ceil(20 / (0.5 * 10)) = 4 replicas, not 2
+    assert d.action == "scale_up" and d.target == 4
+
+
+# -- scale-down -------------------------------------------------------------
+
+
+def test_scale_down_needs_longer_cold_streak():
+    e = PolicyEngine(cfg())
+    for i in range(2):
+        assert e.decide(3, obs(i, **COLD)).action == "hold"
+    d = e.decide(3, obs(2, **COLD))
+    assert d.action == "scale_down" and d.target == 2
+    assert d.reason == "idle"
+
+
+def test_idle_fleet_with_healthy_scrape_reads_cold():
+    """Scrape fine, zero traffic in the window (p99 None, burn None)
+    is idle — the drain-back-to-baseline path after a load drop."""
+    e = PolicyEngine(cfg())
+    for i in range(2):
+        e.decide(2, obs(i))
+    assert e.decide(2, obs(2)).action == "scale_down"
+
+
+def test_blind_scrape_never_reads_cold():
+    """A FAILED /metrics scrape shows the same p99=None as an idle
+    fleet — but blindness must never accumulate into shrinking a
+    fleet that may be under live load."""
+    e = PolicyEngine(cfg())
+    for i in range(20):
+        d = e.decide(3, obs(i, metrics_ok=False))
+        assert d.action == "hold", (i, d)
+        assert d.reason == "in_band"
+    # evidence returns and says idle: the cold streak starts FRESH
+    assert e.decide(3, obs(21, **COLD)).reason == "cold_streak_building"
+
+
+def test_min_replicas_bounds_scale_down():
+    e = PolicyEngine(cfg())
+    for i in range(2):
+        e.decide(1, obs(i, **COLD))
+    d = e.decide(1, obs(2, **COLD))
+    assert d.action == "hold" and d.reason == "at_min_replicas"
+
+
+def test_down_cooldown_spaces_scale_downs():
+    e = PolicyEngine(cfg())
+    for i in range(2):
+        e.decide(4, obs(i, **COLD))
+    assert e.decide(4, obs(2, **COLD)).action == "scale_down"
+    for i in range(3, 5):
+        e.decide(3, obs(i, **COLD))
+    d = e.decide(3, obs(5, **COLD))
+    assert d.action == "hold" and d.reason == "down_cooldown"
+
+
+def test_half_open_replica_bans_scale_down():
+    """A benched/half-open replica means the fleet is mid-recovery:
+    shrinking now shoots the survivors (the ISSUE's explicit ban)."""
+    e = PolicyEngine(cfg())
+    for i in range(2):
+        e.decide(3, obs(i, **COLD))
+    d = e.decide(3, obs(2, replicas_half_open=1, **COLD))
+    assert d.action == "hold" and d.reason == "replica_recovering"
+    # also banned on unhealthy
+    e2 = PolicyEngine(cfg())
+    for i in range(2):
+        e2.decide(3, obs(i, **COLD))
+    d2 = e2.decide(3, obs(2, replicas_unhealthy=1, **COLD))
+    assert d2.action == "hold" and d2.reason == "replica_recovering"
+
+
+def test_p99_inside_objective_but_over_headroom_is_not_cold():
+    e = PolicyEngine(cfg())  # headroom band ends at 50ms
+    for i in range(5):
+        d = e.decide(3, obs(i, p99=0.08))
+        assert d.action == "hold"
+        assert d.reason == "in_band"
+
+
+# -- config validation + phase math -----------------------------------------
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="min_replicas"):
+        PolicyConfig(min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        PolicyConfig(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="hysteresis"):
+        PolicyConfig(up_burn=1.0, down_burn=1.0)
+
+
+def test_phase_shares_aggregates_and_degrades():
+    assert phase_shares([]) == {}
+    assert phase_shares([{}]) == {}
+    shares = phase_shares(
+        [
+            {"device": 2.0, "queue_wait": 6.0},
+            {"device": 1.0, "queue_wait": 1.0, "deliver": None},
+        ]
+    )
+    assert shares["device"] == pytest.approx(0.3)
+    assert shares["queue_wait"] == pytest.approx(0.7)
+
+
+def test_decision_as_dict_is_json_shaped():
+    import json
+
+    e = PolicyEngine(cfg())
+    d = e.decide(1, obs(0, p99=0.2, phase_shares={"queue_wait": 1.0}))
+    doc = json.loads(json.dumps(d.as_dict()))
+    assert doc["action"] == "hold"
+    assert doc["observation"]["dominant_phase"] == "queue_wait"
+    assert "latency_buckets" not in doc["observation"]

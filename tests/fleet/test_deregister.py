@@ -1,0 +1,195 @@
+"""Graceful retirement + the request-path partition: registry
+``remove``, the router's ``POST /deregisterz``, and the
+``router.replica.partition`` chaos point failing over exactly like a
+connection refusal."""
+
+import itertools
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from keystone_tpu.fleet import ReplicaRegistry, RouterServer
+from keystone_tpu.gateway import Gateway, GatewayServer
+from keystone_tpu.loadgen import faults
+from keystone_tpu.observability.registry import MetricsRegistry
+
+from gateway_fixtures import D, batch, make_fitted
+
+_ids = itertools.count()
+
+
+# -- registry.remove --------------------------------------------------------
+
+
+def test_registry_remove_is_idempotent_roster_removal():
+    reg = ReplicaRegistry(["http://127.0.0.1:9001"])
+    assert len(reg) == 1
+    assert reg.remove("http://127.0.0.1:9001") is True
+    assert len(reg) == 0
+    assert reg.remove("http://127.0.0.1:9001") is False
+    with pytest.raises(ValueError, match="http"):
+        reg.remove("not-a-url")
+
+
+def test_removed_replica_is_never_picked():
+    reg = ReplicaRegistry(
+        ["http://127.0.0.1:9001", "http://127.0.0.1:9002"]
+    )
+    reg.remove("http://127.0.0.1:9001")
+    for _ in range(5):
+        assert reg.pick().url == "http://127.0.0.1:9002"
+
+
+# -- router /deregisterz + partition, end to end ----------------------------
+
+
+def _make_replica(name):
+    reg = MetricsRegistry()
+    gw = Gateway(
+        make_fitted(),
+        buckets=(4, 8),
+        n_lanes=1,
+        max_delay_ms=1.0,
+        warmup_example=np.zeros(D, np.float32),
+        name=name,
+        registry=reg,
+    )
+    srv = GatewayServer(gw, port=0, registry=reg).start()
+    return gw, srv
+
+
+@pytest.fixture
+def fleet():
+    replicas = [
+        _make_replica(f"dereg-r{next(_ids)}") for _ in range(2)
+    ]
+    router = RouterServer(
+        [srv.url() for _, srv in replicas],
+        port=0,
+        name=f"dereg-router{next(_ids)}",
+        registry=MetricsRegistry(),
+        probe_interval_s=0.1,
+        recovery_after_s=0.3,
+    ).start()
+    router.fleet.probe_once()
+    yield router, replicas
+    router.stop()
+    for gw, srv in replicas:
+        gw.close()
+        srv.stop()
+
+
+def _post(url, doc, timeout=30):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(doc).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _predict(router, n=2, seed=0):
+    return _post(
+        router.url("/predict"),
+        {"instances": batch(n, seed=seed).tolist()},
+    )
+
+
+def test_deregisterz_removes_and_routes_around(fleet):
+    router, replicas = fleet
+    (gw0, srv0), (gw1, srv1) = replicas
+    status, doc = _post(
+        router.url("/deregisterz"), {"url": srv0.url()}
+    )
+    assert status == 200
+    assert doc == {"deregistered": True, "replicas": 1}
+    # idempotent: a second deregister of the same URL is a no-op
+    status, doc = _post(
+        router.url("/deregisterz"), {"url": srv0.url()}
+    )
+    assert doc == {"deregistered": False, "replicas": 1}
+    # every forward now lands on the survivor
+    for seed in range(4):
+        status, _ = _predict(router, seed=seed)
+        assert status == 200
+    assert gw0.metrics.outcome_count("ok") == 0.0
+    assert gw1.metrics.outcome_count("ok") == 8.0
+
+
+def test_deregisterz_rejects_garbage(fleet):
+    router, _ = fleet
+    import urllib.error
+
+    for body in ({}, {"url": 7}, {"url": "nope"}):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(router.url("/deregisterz"), body)
+        assert err.value.code == 400
+
+
+def test_reregister_after_deregister_rejoins(fleet):
+    router, replicas = fleet
+    _, srv0 = replicas[0]
+    _post(router.url("/deregisterz"), {"url": srv0.url()})
+    assert len(router.fleet) == 1
+    status, doc = _post(
+        router.url("/registerz"), {"url": srv0.url()}
+    )
+    assert doc["registered"] is True and doc["created"] is True
+    assert len(router.fleet) == 2
+
+
+def test_partition_fails_over_and_charges_health(fleet):
+    """``router.replica.partition`` severs the forward BEFORE it
+    dials: the matched replica never sees the request, traffic fails
+    over to the sibling, and the replica is benched on request
+    evidence — exactly the connection-refusal contract."""
+    router, replicas = fleet
+    (gw0, srv0), (gw1, srv1) = replicas
+    fired_before = faults.get_injector().fired_count(
+        "router.replica.partition"
+    )
+    faults.arm("router.replica.partition", match={"index": 0})
+    try:
+        for seed in range(6):
+            status, doc = _predict(router, seed=seed)
+            assert status == 200
+            assert len(doc["predictions"]) == 2
+    finally:
+        faults.disarm("router.replica.partition")
+    # the partitioned replica served NOTHING (request-path severed,
+    # unlike blackhole where the work happens and the response drops)
+    assert gw0.metrics.outcome_count("ok") == 0.0
+    assert gw1.metrics.outcome_count("ok") == 12.0
+    fired = (
+        faults.get_injector().fired_count("router.replica.partition")
+        - fired_before
+    )
+    assert fired >= 3
+    # request evidence benched it
+    r0 = router.fleet.find_by_name(
+        srv0.url().replace("http://", "").rstrip("/")
+    )
+    assert r0 is not None
+    assert r0.state in ("unhealthy", "half-open")
+
+
+def test_partition_of_whole_fleet_sheds_typed(fleet):
+    """With every replica partitioned, the router must shed a TYPED
+    503 (closed) — never a naked 500 — the invariant the autoscale
+    drill holds while a partition races a scale-up."""
+    router, _ = fleet
+    import urllib.error
+
+    faults.arm("router.replica.partition")  # no match: everyone
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _predict(router)
+        assert err.value.code == 503
+        doc = json.loads(err.value.read())
+        assert doc["error"] == "overloaded"
+        assert doc["reason"] == "closed"
+    finally:
+        faults.disarm("router.replica.partition")

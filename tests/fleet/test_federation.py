@@ -236,6 +236,48 @@ def test_slo_latency_from_buckets_unobservable_threshold_clamps(caplog):
     assert len(warnings) == 1  # warned once, not per sample
 
 
+def test_slo_latency_from_buckets_dead_replica_reads():
+    """The control loop hits these constantly: a read fn that raises
+    mid-scrape is survived by the monitor, but the read itself must
+    also degrade — None and empty-merge inputs are (0, 0), never an
+    exception, never invented zeros-as-bad."""
+    slo = Slo.latency_from_buckets(
+        "fleet:none", lambda: None, threshold_s=0.1, target=0.99
+    )
+    assert slo.read() == (0.0, 0.0)
+    # a fleet where EVERY replica's scrape was empty merges to []
+    slo = Slo.latency_from_buckets(
+        "fleet:dead",
+        lambda: merge_histograms([[], []]),
+        threshold_s=0.1,
+        target=0.99,
+    )
+    assert slo.read() == (0.0, 0.0)
+
+
+def test_slo_latency_from_buckets_partial_merge():
+    """One replica dead (empty contribution), one alive: the merged
+    read is the survivor's distribution — partial, not absent."""
+    alive = [(0.1, 80.0), (0.5, 95.0), (INF, 100.0)]
+    slo = Slo.latency_from_buckets(
+        "fleet:partial",
+        lambda: merge_histograms([[], alive, []]),
+        threshold_s=0.1,
+        target=0.99,
+    )
+    assert slo.read() == (100.0, 20.0)
+
+
+def test_slo_latency_from_buckets_inf_only_layout():
+    """A degenerate scrape carrying only the +Inf bucket cannot judge
+    any request good or bad at a finite threshold — total counted,
+    zero bad (unjudgeable, not failing)."""
+    slo = Slo.latency_from_buckets(
+        "fleet:inf", lambda: [(INF, 7.0)], threshold_s=0.1, target=0.99
+    )
+    assert slo.read() == (7.0, 0.0)
+
+
 def test_merge_expositions_single_scrape_is_normalizing_identity():
     body = merge_expositions([SCRAPE_A])
     assert parse_samples(body) == parse_samples(SCRAPE_A)

@@ -1,0 +1,136 @@
+"""The step/ramp offered-load shape (``synthesize_steps``) and its
+CLI spec — the deterministic load staircase the autoscale drills and
+the capacity planner script."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.loadgen.trace import (
+    parse_steps,
+    synthesize,
+    synthesize_steps,
+)
+
+
+def test_parse_steps_grammar():
+    assert parse_steps("5:4,40:8,5:6") == [
+        (5.0, 4.0), (40.0, 8.0), (5.0, 6.0),
+    ]
+    assert parse_steps("2.5:0.5") == [(2.5, 0.5)]
+    with pytest.raises(ValueError, match="rate:duration"):
+        parse_steps("5,40")
+    with pytest.raises(ValueError):
+        parse_steps("x:1")
+
+
+def test_steps_produce_per_step_rates():
+    events = synthesize_steps(
+        [(10.0, 10.0), (100.0, 10.0), (10.0, 10.0)],
+        arrivals="uniform",
+        shape=(4,),
+        seed=3,
+    )
+    ts = np.asarray([e.ts for e in events])
+    low1 = ((ts >= 0) & (ts < 10)).sum()
+    high = ((ts >= 10) & (ts < 20)).sum()
+    low2 = ((ts >= 20) & (ts < 30)).sum()
+    # uniform arrivals: counts are exact up to edge effects
+    assert low1 == pytest.approx(100, abs=2)
+    assert high == pytest.approx(1000, abs=2)
+    assert low2 == pytest.approx(100, abs=2)
+    # arrivals stay inside the schedule and ascend
+    assert ts.max() < 30.0
+    assert (np.diff(ts) > 0).all()
+
+
+def test_steps_poisson_rates_are_approximate():
+    events = synthesize_steps(
+        [(20.0, 20.0), (200.0, 5.0)], shape=(4,), seed=11
+    )
+    ts = np.asarray([e.ts for e in events])
+    low = ((ts >= 0) & (ts < 20)).sum()
+    high = ((ts >= 20) & (ts < 25)).sum()
+    assert 250 <= low + high <= 2000
+    # the surge is an order of magnitude denser than the baseline
+    assert (high / 5.0) > 4 * (low / 20.0)
+
+
+def test_zero_rate_step_is_a_silence():
+    events = synthesize_steps(
+        [(50.0, 2.0), (0.0, 3.0), (50.0, 2.0)],
+        arrivals="uniform",
+        shape=(4,),
+        seed=0,
+    )
+    ts = np.asarray([e.ts for e in events])
+    assert ((ts >= 2.0) & (ts < 5.0)).sum() == 0
+    assert ((ts >= 5.0) & (ts < 7.0)).sum() > 0
+
+
+def test_steps_deterministic_per_seed():
+    kw = dict(shape=(4,), size_mix=((1, 0.5), (4, 0.5)))
+    a = synthesize_steps([(30.0, 3.0)], seed=7, **kw)
+    b = synthesize_steps([(30.0, 3.0)], seed=7, **kw)
+    c = synthesize_steps([(30.0, 3.0)], seed=8, **kw)
+    assert [(e.ts, e.n_rows) for e in a] == [(e.ts, e.n_rows) for e in b]
+    assert [(e.ts, e.n_rows) for e in a] != [(e.ts, e.n_rows) for e in c]
+
+
+def test_steps_carry_sizes_shapes_deadlines():
+    events = synthesize_steps(
+        [(40.0, 2.0)],
+        shape=(16,),
+        size_mix=((2, 1.0),),
+        deadline_ms=50.0,
+        seed=1,
+    )
+    assert all(e.shape == (16,) for e in events)
+    assert all(e.n_rows == 2 for e in events)
+    assert all(e.deadline_ms == 50.0 for e in events)
+
+
+def test_steps_validation():
+    with pytest.raises(ValueError, match="at least one step"):
+        synthesize_steps([])
+    with pytest.raises(ValueError, match="durations"):
+        synthesize_steps([(10.0, 0.0)])
+    with pytest.raises(ValueError, match="rates"):
+        synthesize_steps([(-1.0, 5.0)])
+    with pytest.raises(ValueError, match="no arrivals"):
+        synthesize_steps([(0.001, 0.5)], seed=0)
+    # a typo'd rate must fail loud, never loop/allocate forever
+    with pytest.raises(ValueError, match="rates must be finite"):
+        synthesize_steps([(float("inf"), 5.0)])
+    with pytest.raises(ValueError, match="durations must be finite"):
+        synthesize_steps([(10.0, float("inf"))])
+    with pytest.raises(ValueError, match="2e6"):
+        synthesize_steps([(1e7, 60.0)])
+
+
+def test_single_step_matches_synthesize_statistics():
+    """One step at rate r for T seconds is the same workload family
+    as synthesize(n~rT) — the staircase generalizes, not replaces."""
+    steps = synthesize_steps([(100.0, 5.0)], shape=(4,), seed=5)
+    flat = synthesize(500, rate=100.0, shape=(4,), seed=5)
+    assert len(steps) == pytest.approx(len(flat), rel=0.25)
+
+
+def test_cli_ramp_builds_step_events():
+    from keystone_tpu.loadgen.cli import _build_events, build_parser
+
+    args = build_parser().parse_args(
+        ["--ramp", "10:1,50:1", "--arrivals", "uniform", "--d", "8"]
+    )
+    events = _build_events(args)
+    assert len(events) == pytest.approx(60, abs=3)
+    assert all(e.shape == (8,) for e in events)
+
+
+def test_cli_ramp_is_exclusive_with_other_workloads():
+    from keystone_tpu.loadgen.cli import _build_events, build_parser
+
+    args = build_parser().parse_args(
+        ["--ramp", "10:1", "--synthetic", "5"]
+    )
+    with pytest.raises(SystemExit, match="exactly one"):
+        _build_events(args)
