@@ -1,0 +1,135 @@
+#!/usr/bin/env bash
+# Smoke-test device-side featurization end to end:
+#
+#  1. the `serving_device_featurize` bench row — the same image
+#     featurize chain + model served through a host_featurize gateway
+#     vs a device_featurize gateway, with the row's own asserts
+#     (outputs allclose, device-path H2D bytes/request <= 1/3 of the
+#     host path, device examples/sec >= host) re-checked here off the
+#     emitted JSON;
+#  2. a real `serve-gateway --device-featurize` subprocess: POST a raw
+#     uint8 image to /predict, assert predictions come back and that
+#     `keystone_serving_h2d_bytes_total` is on /metrics with the raw
+#     byte footprint (bucket * img * img * 3) — the wire-bytes win as
+#     a scraped fact.
+#
+# CI-friendly: CPU backend, ~60s, no network beyond localhost.
+#
+#   bin/smoke-featurize.sh
+set -euo pipefail
+
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+TMPDIR="$(mktemp -d)"
+SERVER_LOG="$TMPDIR/server.log"
+BENCH_OUT="$TMPDIR/bench.jsonl"
+cleanup() {
+    [[ -n "${SERVER_PID:-}" ]] && kill "$SERVER_PID" 2>/dev/null || true
+    rm -rf "$TMPDIR"
+}
+trap cleanup EXIT
+
+echo "== serving_device_featurize bench row =="
+JAX_PLATFORMS=cpu PYTHONPATH="$ROOT" \
+    python -m keystone_tpu serve-bench --featurize-only \
+    | tee "$BENCH_OUT"
+
+python - "$BENCH_OUT" <<'PY'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+row = next(r for r in rows if r.get("metric") == "serving_device_featurize")
+assert row["outputs_allclose"] is True, row
+assert row["h2d_reduction"] >= 3.0, row
+assert row["device_examples_per_sec"] >= row["host_examples_per_sec"], row
+assert row["device_bottleneck"] not in ("host_prep", "upload"), row
+print(
+    f"row OK: {row['device_examples_per_sec']} ex/s device vs "
+    f"{row['host_examples_per_sec']} host, "
+    f"{row['h2d_reduction']}x fewer H2D bytes/request, "
+    f"bottleneck {row['host_bottleneck']} -> {row['device_bottleneck']}"
+)
+PY
+echo "PASS bench row"
+
+echo "== serve-gateway --device-featurize drill =="
+IMG=8
+JAX_PLATFORMS=cpu PYTHONPATH="$ROOT" \
+    python -m keystone_tpu serve-gateway --gateway-port 0 \
+    --device-featurize --img "$IMG" --buckets 4,8 --lanes 1 \
+    --hidden 64 --depth 2 >"$SERVER_LOG" 2>&1 &
+SERVER_PID=$!
+
+BASE=""
+for _ in $(seq 1 240); do
+    BASE="$(python - "$SERVER_LOG" <<'PY'
+import json, sys
+try:
+    for line in open(sys.argv[1]):
+        line = line.strip()
+        if line.startswith("{"):
+            print(json.loads(line)["listening"]); break
+except Exception:
+    pass
+PY
+)"
+    [[ -n "$BASE" ]] && break
+    kill -0 "$SERVER_PID" 2>/dev/null || {
+        echo "FAIL: gateway died before binding"; cat "$SERVER_LOG"; exit 1; }
+    sleep 0.5
+done
+[[ -n "$BASE" ]] || { echo "FAIL: no handshake after 120s"; cat "$SERVER_LOG"; exit 1; }
+echo "gateway up on $BASE"
+
+# one raw uint8 image instance (IMG x IMG x 3 nested JSON ints)
+PRED="$(python - "$BASE" "$IMG" <<'PY'
+import json, sys, urllib.request
+base, img = sys.argv[1], int(sys.argv[2])
+inst = [[[x % 251, y % 251, (x + y) % 251] for y in range(img)]
+        for x in range(img)]
+req = urllib.request.Request(
+    base + "/predict",
+    data=json.dumps({"instances": [inst]}).encode(),
+    headers={"Content-Type": "application/json"},
+)
+print(urllib.request.urlopen(req, timeout=60).read().decode())
+PY
+)"
+grep -q '"predictions"' <<<"$PRED" || {
+    echo "FAIL: /predict returned: $PRED"; cat "$SERVER_LOG"; exit 1; }
+echo "PASS /predict (raw uint8 image in, predictions out)"
+
+# malformed raw payload: a pixel out of uint8 range is the CLIENT's
+# error — typed 400 bad_request, never a 500 + server stack trace
+BADCODE="$(python - "$BASE" <<'PY'
+import json, sys, urllib.request, urllib.error
+req = urllib.request.Request(
+    sys.argv[1] + "/predict",
+    data=json.dumps({"instances": [[[[256, 0, 0]]]]}).encode(),
+    headers={"Content-Type": "application/json"},
+)
+try:
+    print(urllib.request.urlopen(req, timeout=30).status)
+except urllib.error.HTTPError as e:
+    print(e.code)
+PY
+)"
+[[ "$BADCODE" == "400" ]] || {
+    echo "FAIL: out-of-range pixel returned $BADCODE, want 400"; exit 1; }
+echo "PASS /predict out-of-range pixel -> 400 bad_request"
+
+METRICS="$(python -c 'import sys, urllib.request; \
+sys.stdout.write(urllib.request.urlopen(sys.argv[1], timeout=15).read().decode())' \
+    "$BASE/metrics")"
+# the single-instance window dispatches bucket 4: 4 * IMG*IMG*3 raw
+# uint8 bytes staged — raw-on-the-wire, exactly accounted
+WANT_BYTES=$((4 * IMG * IMG * 3))
+grep -qF "keystone_serving_h2d_bytes_total{engine=\"gateway-lane0\",bucket=\"4\"} $WANT_BYTES" \
+    <<<"$METRICS" || {
+    echo "FAIL: /metrics missing the h2d bytes counter ($WANT_BYTES expected):"
+    grep keystone_serving_h2d <<<"$METRICS" || true
+    exit 1; }
+echo "PASS /metrics keystone_serving_h2d_bytes_total ($WANT_BYTES raw bytes)"
+
+kill "$SERVER_PID" 2>/dev/null || true
+wait "$SERVER_PID" 2>/dev/null || true
+SERVER_PID=""
+echo "smoke-featurize: all checks passed"

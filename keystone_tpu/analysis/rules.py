@@ -446,6 +446,11 @@ HOT_PATH_MODULES: Dict[str, Set[str]] = {
         # numpy out, by design (the prep stage burns host cores while
         # the device computes the previous window)
         "CompiledPipeline.host_stage",
+        # NOT listed, deliberately: compute_staged's H2D-bytes read
+        # (`a.nbytes` over the staged leaves) is array METADATA —
+        # shape x itemsize, no device round-trip — so the
+        # device-featurize accounting needs no gather-once exemption;
+        # adding one here would license real syncs on the dispatch path
     },
     "keystone_tpu/serving/pipeline.py": {
         # THE gather-once point: one np.asarray per window, futures

@@ -8,6 +8,9 @@ A stdlib ``http.server`` on a background daemon thread, following the
   instance one example WITHOUT the batch axis; numbers nest as JSON
   arrays), optional ``"deadline_ms"``. Every instance is admitted
   individually, so concurrent clients coalesce in the micro-batchers.
+  Under ``--device-featurize`` (``input_dtype=uint8``) instances are
+  RAW uint8 images — the staging path carries raw bytes and the fused
+  featurize∘model bucket program does the rest on device.
   Responds ``{"predictions": [...]}``; typed errors map to status
   codes: 429 shed (``Overloaded``: queue_full/deadline), 504 expired,
   503 draining/closed, 400 malformed, 500 engine error. An inbound
@@ -419,8 +422,11 @@ class _Handler(JsonHandler):
             return
         dtype = self.server.input_dtype  # type: ignore[attr-defined]
         try:
+            # OverflowError: an out-of-range integer against a narrow
+            # dtype (a 256 pixel under --device-featurize's uint8) is
+            # a malformed REQUEST — 400, not a 500 + stack trace
             examples = [np.asarray(inst, dtype=dtype) for inst in instances]
-        except (ValueError, TypeError) as e:
+        except (ValueError, TypeError, OverflowError) as e:
             self._send_error_json(400, "bad_request", detail=str(e))
             return
         # replay context for every log line this POST emits (including
@@ -695,6 +701,20 @@ def main(argv=None) -> int:
     ap.add_argument("--d", type=int, default=256)
     ap.add_argument("--hidden", type=int, default=512)
     ap.add_argument("--depth", type=int, default=4)
+    ap.add_argument("--device-featurize", action="store_true",
+                    help="serve RAW uint8 images instead of f32 "
+                    "feature vectors: a pure-JAX image featurize "
+                    "chain (serving/featurize.build_featurize_pipeline) "
+                    "is fused in front of the model inside every "
+                    "bucket program, so /predict instances are "
+                    "(--img, --img, 3) uint8 arrays, the wire/staging "
+                    "path carries ~4x fewer bytes, and cast + "
+                    "featurize + predict ride one compiled dispatch "
+                    "(--d is derived from the featurize output and "
+                    "ignored)")
+    ap.add_argument("--img", type=int, default=16,
+                    help="raw image edge length under "
+                    "--device-featurize")
     ap.add_argument("--no-cache", action="store_true",
                     help="run with NO persistence: skips both the "
                     "persistent XLA compile cache and the AOT "
@@ -721,14 +741,28 @@ def main(argv=None) -> int:
 
         enable_tracing()
 
+    featurize = None
+    input_dtype = np.float32
+    if args.device_featurize:
+        from keystone_tpu.serving.featurize import (
+            build_featurize_pipeline,
+        )
+
+        featurize, feat_d = build_featurize_pipeline(img=args.img)
+        args.d = feat_d  # the model consumes the featurize output
+        warmup_example = jnp.zeros((args.img, args.img, 3), jnp.uint8)
+        input_dtype = np.uint8
     fitted = build_pipeline(d=args.d, hidden=args.hidden, depth=args.depth)
+    if not args.device_featurize:
+        warmup_example = jnp.zeros((args.d,), jnp.float32)
     gateway = Gateway(
         fitted,
         buckets=tuple(int(b) for b in args.buckets.split(",")),
         n_lanes=args.lanes,
         max_delay_ms=args.max_delay_ms,
         pipeline_depth=args.pipeline_depth,
-        warmup_example=jnp.zeros((args.d,), jnp.float32),
+        device_featurize=featurize,
+        warmup_example=warmup_example,
         max_pending=args.max_pending,
         default_deadline_ms=args.deadline_ms,
         maintenance_interval_s=args.rebucket_interval,
@@ -749,6 +783,7 @@ def main(argv=None) -> int:
     faults.arm_from_env()
     server = GatewayServer(
         gateway, port=args.port, host=args.host,
+        input_dtype=input_dtype,
         request_log=args.request_log,
         chaos_routes=not args.no_chaosz,
     ).start()

@@ -104,6 +104,22 @@ class Gateway:
                        host-prep stage (or inline when serial), so
                        tokenizer/featurizer front-ends burn host cores
                        while the device computes the previous window.
+    device_featurize:  optional fitted featurize pipeline fused into
+                       every lane engine's bucket programs IN FRONT of
+                       ``fitted`` (``CompiledPipeline(featurize=...)``):
+                       clients submit RAW examples (e.g. uint8 images
+                       — ~4× fewer H2D bytes than f32 features), the
+                       host-prep stage only stacks/pads them into the
+                       pooled staging buffers, and cast + featurize +
+                       predict ride one compiled dispatch. Requires a
+                       traceable (pure-JAX, array-mode) featurize
+                       chain; keep ``host_featurize`` for native/
+                       items-mode featurizers — the two COMPOSE (host
+                       hook decodes raw bytes into uint8 arrays, the
+                       device stage featurizes them). Swaps/rebuckets
+                       rebuild lane engines with the same fused stage;
+                       ``warmup_example`` must be a RAW example in
+                       this mode.
     max_pending:       admission queue bound.
     default_deadline_ms: deadline applied to requests that don't carry
                        their own.
@@ -141,6 +157,7 @@ class Gateway:
         warmup_example: Any = None,
         pipeline_depth: int = 2,
         host_featurize=None,
+        device_featurize=None,
         max_pending: int = 1024,
         default_deadline_ms: Optional[float] = None,
         maintenance_interval_s: Optional[float] = None,
@@ -165,6 +182,10 @@ class Gateway:
         # rebucket loop must force and proposal comparisons are stable
         self._buckets = tuple(sorted(set(int(b) for b in buckets)))
         self._warmup_example = warmup_example
+        # fused into every engine generation the factory builds —
+        # initial lanes, rebucket replacements, and warm-pool swaps all
+        # carry the same device-side featurize stage
+        self._device_featurize = device_featurize
         self._rebucket_k = rebucket_k or len(self._buckets)
         self.metrics = GatewayMetrics(registry=registry, gateway=name)
         self.pool = EnginePool(
@@ -258,7 +279,10 @@ class Gateway:
 
     def _factory_for(self, buckets):
         def factory(lane_name: str):
-            return self.fitted.compiled(buckets=buckets, name=lane_name)
+            return self.fitted.compiled(
+                buckets=buckets, name=lane_name,
+                featurize=self._device_featurize,
+            )
 
         return factory
 

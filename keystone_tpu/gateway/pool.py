@@ -8,8 +8,14 @@ one-engine-per-host multi-host serving later). With
 (serving/pipeline.py: host-prep / upload / compute / deliver threads
 behind bounded handoff queues), overlapping one window's host work
 with the previous window's device compute; ``host_featurize`` plugs an
-items-mode front-end into every lane's prep stage. The pool adds the
-three things a replica set needs beyond execution:
+items-mode front-end into every lane's prep stage. Device-side
+featurization rides the ``engine_factory`` instead: the Gateway's
+factory builds each lane engine with
+``CompiledPipeline(featurize=...)``, so every generation (initial
+build, rebucket replacements, warm-pool swaps) carries the fused
+featurize∘model programs and lanes stage raw bytes — bare-pool users
+bake ``featurize=`` into their own factory the same way. The pool adds
+the three things a replica set needs beyond execution:
 
 - **least-loaded routing** — ``submit()`` hands each request to the
   healthy lane with the fewest unresolved requests, so one slow window

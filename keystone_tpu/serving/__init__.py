@@ -8,6 +8,11 @@ production inference engine:
   incoming batches are zero-padded up to a fixed set of row buckets so
   steady-state traffic compiles at most ``len(buckets)`` XLA programs,
   with input-buffer donation and an optional mesh-sharded variant.
+  ``featurize=`` fuses a second fitted (pure-JAX) featurize pipeline
+  in front of the model inside every bucket program — device-side
+  featurization: raw uint8 staged (~4× fewer H2D bytes than f32
+  features, counted by ``keystone_serving_h2d_bytes_total``), cast +
+  featurize + predict in one dispatch.
 - ``MicroBatcher`` (batching.py): adaptive micro-batching — a
   thread-safe queue that coalesces single-example ``submit()`` requests
   into spec-homogeneous windows (interleaved request streams with

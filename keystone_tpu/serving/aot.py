@@ -243,13 +243,19 @@ def bucket_key(
     shard: bool,
     model_token: str,
     identity: Optional[Dict[str, Any]] = None,
+    featurize_token: Optional[str] = None,
 ) -> Tuple[str, Dict[str, Any]]:
     """Fingerprint one bucket program. Returns ``(key, meta)`` where
     ``key`` is the store filename stem and ``meta`` is the full
     human-readable field dict — stored inside the entry and re-checked
     on load, so even a filename collision cannot install a wrong
     executable. ``identity`` is ``runtime_identity()``, passed in by
-    loops that fingerprint many buckets."""
+    loops that fingerprint many buckets. ``featurize_token`` is the
+    ``pipeline_token`` of a fused device-side featurize stage (engine
+    ``featurize=``), or None for plain model programs: the featurize
+    parameters are constants inside the serialized executable just like
+    the model weights, so fused and unfused programs — and programs
+    fused with DIFFERENT featurizers — must never share an entry."""
     meta: Dict[str, Any] = {
         "format": STORE_FORMAT,
         "specs": [
@@ -260,6 +266,15 @@ def bucket_key(
         "donate": bool(donate),
         "shard": bool(shard),
         "model_token": model_token,
+        # present ONLY for fused programs: unconditionally stamping
+        # None here would shift every unfused key and cold-start every
+        # existing store on upgrade. Fused vs unfused still can never
+        # collide — the extra key changes the fused hash, and the meta
+        # re-check rejects a planted entry whose key set differs.
+        **(
+            {"featurize_token": featurize_token}
+            if featurize_token is not None else {}
+        ),
         **(identity if identity is not None else runtime_identity()),
     }
     blob = json.dumps(meta, sort_keys=True).encode()

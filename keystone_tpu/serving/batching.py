@@ -69,8 +69,12 @@ logger = logging.getLogger(__name__)
 # (example, future, enqueue time, optional parent span id)
 _Entry = Tuple[Any, Future, float, Optional[int]]
 
-# all raw items coalesce into ONE stream when a host featurizer owns
-# the window: the hook defines homogeneity, not per-item array shape
+# NON-ARRAY raw items (strings, records, ragged pytrees) coalesce into
+# ONE stream when a host featurizer owns the window: the hook defines
+# homogeneity there. ARRAY items still key by (shape, dtype) even in
+# items mode — see _example_spec — so mixed-size raw images bucket into
+# per-shape windows instead of collapsing into one stream that pads
+# every window to the largest image ever seen.
 _ITEMS_SPEC = ("items",)
 
 
@@ -139,9 +143,21 @@ class MicroBatcher:
 
     def _example_spec(self, example: Any):
         if self.host_featurize is not None:
-            # items mode: the featurizer owns window homogeneity (raw
-            # strings/records have no stable per-item array spec), so
-            # every submission coalesces into one stream
+            # items mode: the featurizer owns window ASSEMBLY, but
+            # array items still carry a (shape, dtype) identity worth
+            # segregating on — mixed-size raw images used to collapse
+            # into one stream and pad every window to the largest
+            # image, and the hook had to handle ragged windows. Keyed
+            # windows are shape-homogeneous, bucket like array mode,
+            # and stage into per-shape pooled buffers. Non-array items
+            # (strings, records) have no stable per-item spec and keep
+            # the single shared stream.
+            if hasattr(example, "shape") and hasattr(example, "dtype"):
+                return (
+                    "items",
+                    tuple(example.shape),
+                    str(example.dtype),
+                )
             return _ITEMS_SPEC
         leaves, treedef = jax.tree_util.tree_flatten(example)
         return treedef, tuple(self._leaf_spec(a) for a in leaves)

@@ -38,6 +38,16 @@
   hardware peaks are known (``KEYSTONE_PEAK_FLOPS`` /
   ``KEYSTONE_PEAK_MEMBW_GBPS`` override for unlisted hardware; without
   peaks those fields report null — never fabricated zeros).
+- ``serving_device_featurize`` — the device-side featurization A/B
+  (``--featurize``/``--featurize-only``; run by
+  ``bin/smoke-featurize.sh``): the same image featurize chain + model
+  served through a ``host_featurize`` gateway (prep stage featurizes
+  on host, engine stages f32 features) vs a ``device_featurize``
+  gateway (raw uint8 staged, cast + featurize + predict fused into one
+  per-bucket XLA program). Asserted: outputs allclose, device-path H2D
+  bytes/request <= 1/3 of the host path (off the engines' own
+  ``keystone_serving_h2d_bytes_total`` counters), and sustained
+  device-path examples/sec >= host. Headline: device examples/sec.
 - ``serving_chaos_lane_kill`` / ``serving_chaos_prep_stall`` — the
   chaos-harness regression rows (``--chaos``; run by
   ``bin/smoke-chaos.sh``): sustained open-loop load through a full
@@ -638,6 +648,210 @@ def bench_goodput_mfu(
                 str(b): m.roofline_bound(b) for b in engine.buckets
             },
             "cost_analysis_available": bool(cost_model_buckets),
+        },
+    )
+
+
+def bench_device_featurize(
+    emit,
+    img: int = 16,
+    hidden: int = 256,
+    depth: int = 3,
+    buckets: Sequence[int] = (8, 32),
+    n_requests: int = 384,
+    n_threads: int = 8,
+    n_check: int = 32,
+    min_h2d_reduction: float = 3.0,
+) -> None:
+    """``serving_device_featurize`` — the device-side featurization A/B:
+    the SAME featurize chain (``build_featurize_pipeline``) and model
+    served two ways through full gateways —
+
+    - **host path**: the existing ``host_featurize`` seam — the prep
+      stage featurizes each coalesced window on the host (jitted batch
+      featurize, the strongest host baseline) and the engine stages the
+      resulting f32 features;
+    - **device path**: ``device_featurize`` — raw uint8 images stage
+      into the pooled staging buffers, and cast + featurize + predict
+      ride ONE fused per-bucket XLA program.
+
+    Asserted (raises, not asserts): outputs numerically matching
+    (allclose), H2D bytes/request on the device path ≤ 1/3 of the host
+    path (read off the engines' own ``keystone_serving_h2d_bytes_total``
+    counters, padding included — the scraped fact, not the geometric
+    claim), and sustained device-path examples/sec >= the host path
+    (one bounded re-measure absorbs scheduler jitter: both paths are
+    re-run once before the row fails). Headline: device-path
+    examples/sec; ``extra`` carries both paths' rates, bytes/request,
+    and per-stage bottleneck attribution — the host path's bottleneck
+    sits in ``host_prep`` (featurize burns the prep stage), the device
+    path's moves off ``host_prep``/``upload`` into the fused dispatch.
+
+    The host path also pays the cost the seam can't avoid: window sizes
+    vary with coalescing, so the host featurizer retraces per new
+    window size while the device path's fused programs are bounded by
+    the bucket list — warm passes cover the common sizes for fairness,
+    but the structural difference is the measurement's point."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.gateway import Gateway
+    from keystone_tpu.serving.engine import CompiledPipeline
+    from keystone_tpu.serving.featurize import build_featurize_pipeline
+
+    featurize, feat_d = build_featurize_pipeline(img=img)
+    model = build_pipeline(d=feat_d, hidden=hidden, depth=depth)
+    rng = np.random.default_rng(11)
+    check = rng.integers(
+        0, 256, (n_check, img, img, 3), dtype=np.uint8
+    )
+    raws = rng.integers(
+        0, 256, (n_requests, img, img, 3), dtype=np.uint8
+    )
+
+    feat_jit = featurize.jit_batch()
+
+    def host_hook(raw):
+        batch = np.stack([np.asarray(r, np.uint8) for r in raw])
+        return np.asarray(feat_jit(batch))
+
+    def drive(gw, inputs):
+        served = [None] * len(inputs)
+        errors = []
+
+        def client(tid):
+            # a shed/timeout must FAIL the row, not silently kill this
+            # thread: a dead client issues fewer requests, which would
+            # shrink dt and overstate the path's rate (and leave None
+            # outputs the comparison would trip over later)
+            try:
+                for i in range(tid, len(inputs), n_threads):
+                    served[i] = np.asarray(
+                        gw.predict(inputs[i]).result(timeout=120)
+                    )
+            except Exception as e:
+                errors.append(e)
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=client, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(
+                f"device-featurize bench client failed on "
+                f"{gw.name}: {errors[0]!r}"
+            ) from errors[0]
+        return time.perf_counter() - t0, served
+
+    def measure(gw, host_inputs):
+        # unmeasured warm pass (pool buffers, BLAS paths, the host
+        # hook's per-window-size retraces for the common sizes), then
+        # best-of-2 sustained passes — the stream bench's discipline
+        drive(gw, host_inputs[: n_requests // 2])
+        dt = float("inf")
+        for _ in range(2):
+            dt = min(dt, drive(gw, host_inputs)[0])
+        return n_requests / dt
+
+    def engine_of(gw) -> CompiledPipeline:
+        return gw.pool.lanes[0].engine
+
+    gw_host = Gateway(
+        model, buckets=buckets, n_lanes=1, max_delay_ms=2.0,
+        host_featurize=host_hook,
+        warmup_example=jnp.zeros((feat_d,), jnp.float32),
+        name="bench-feat-host",
+    )
+    gw_dev = Gateway(
+        model, buckets=buckets, n_lanes=1, max_delay_ms=2.0,
+        device_featurize=featurize,
+        warmup_example=jnp.zeros((img, img, 3), jnp.uint8),
+        name="bench-feat-device",
+    )
+    try:
+        host = {"outputs": drive(gw_host, list(check))[1]}
+        dev = {"outputs": drive(gw_dev, list(check))[1]}
+        host["rate"] = measure(gw_host, list(raws))
+        dev["rate"] = measure(gw_dev, list(raws))
+        if dev["rate"] < host["rate"]:
+            # one bounded re-measure of BOTH paths (scheduler jitter on
+            # a loaded CI host is large relative to one pass); best of
+            # all observed passes per path, then the assert is final
+            host["rate"] = max(
+                host["rate"], measure(gw_host, list(raws))
+            )
+            dev["rate"] = max(dev["rate"], measure(gw_dev, list(raws)))
+        for side, gw in (("host", gw_host), ("device", gw_dev)):
+            m = engine_of(gw).metrics
+            report = m.pipeline_report() or {}
+            d_ = host if side == "host" else dev
+            d_["bytes_per_request"] = (
+                m.h2d_bytes.total / m.examples.total
+            )
+            d_["bottleneck"] = report.get("bottleneck")
+            d_["compiles"] = m.compiles.total
+    finally:
+        gw_host.close()
+        gw_dev.close()
+    maxdiff = max(
+        float(np.abs(a - b).max())
+        for a, b in zip(host["outputs"], dev["outputs"])
+    )
+    for i, (a, b) in enumerate(zip(host["outputs"], dev["outputs"])):
+        if not np.allclose(a, b, rtol=1e-4, atol=1e-5):
+            raise RuntimeError(
+                f"device-featurize output {i} diverges from the host "
+                f"path (max abs diff {np.abs(a - b).max():.3e})"
+            )
+    reduction = host["bytes_per_request"] / dev["bytes_per_request"]
+    if reduction < min_h2d_reduction:
+        raise RuntimeError(
+            f"device path ships {dev['bytes_per_request']:.0f} "
+            f"H2D bytes/request vs the host path's "
+            f"{host['bytes_per_request']:.0f} — only "
+            f"{reduction:.2f}x fewer (need >= {min_h2d_reduction}x)"
+        )
+    if dev["rate"] < host["rate"]:
+        raise RuntimeError(
+            f"device-featurize path sustains {dev['rate']:.1f} ex/s "
+            f"vs the host path's {host['rate']:.1f} — raw-on-the-wire "
+            "must at least match the host featurize seam"
+        )
+    if dev["bottleneck"] in ("host_prep", "upload"):
+        raise RuntimeError(
+            f"device-featurize lane still bottlenecks on "
+            f"{dev['bottleneck']} — the fused program was supposed to "
+            "move the limiting stage off host prep/H2D"
+        )
+    emit(
+        "serving_device_featurize",
+        dev["rate"], "examples/sec",
+        extra={
+            "host_examples_per_sec": round(host["rate"], 1),
+            "device_examples_per_sec": round(dev["rate"], 1),
+            "speedup_vs_host": round(dev["rate"] / host["rate"], 3),
+            "h2d_bytes_per_request_host": round(
+                host["bytes_per_request"], 1
+            ),
+            "h2d_bytes_per_request_device": round(
+                dev["bytes_per_request"], 1
+            ),
+            "h2d_reduction": round(reduction, 2),
+            "raw_shape": [img, img, 3],
+            "feature_dim": feat_d,
+            "buckets": list(buckets),
+            "requests": n_requests,
+            "client_threads": n_threads,
+            "host_bottleneck": host["bottleneck"],
+            "device_bottleneck": dev["bottleneck"],
+            "host_compiles": host["compiles"],
+            "device_compiles": dev["compiles"],
+            "outputs_allclose": True,
+            "max_abs_diff": maxdiff,
         },
     )
 
@@ -1689,6 +1903,15 @@ def run_fleet_benches(
         bench_router_trace_overhead(emit, fitted, buckets, d)
 
 
+def run_featurize_benches(emit) -> None:
+    """The device-side featurization A/B (~30 s: two gateway warmups +
+    three sustained passes per path; run by ``bin/smoke-featurize.sh``).
+    Its own pipeline shape — the row's geometry (raw uint8 bytes vs
+    featurized f32 bytes) is what the H2D assertion prices, so it
+    doesn't inherit the generic bench dims."""
+    bench_device_featurize(emit)
+
+
 def run_serving_benches(
     emit,
     d: int = 256,
@@ -1699,6 +1922,7 @@ def run_serving_benches(
     cold_start: bool = True,
     fleet: bool = False,
     autoscale: bool = False,
+    featurize: bool = False,
 ) -> None:
     fitted = build_pipeline(d, hidden, depth)
     bench_cold_vs_warm(emit, fitted, buckets, d)
@@ -1739,6 +1963,8 @@ def run_serving_benches(
     if fleet:
         run_fleet_benches(emit, d=d, hidden=hidden, depth=depth,
                           buckets=buckets, fitted=fitted)
+    if featurize:
+        run_featurize_benches(emit)
     if autoscale:
         # its own (smaller) pipeline: scale-up reaction time includes
         # per-replica warmup, which the default bench shape would
@@ -1821,6 +2047,16 @@ def main(argv=None) -> int:
                     "(bin/smoke-fleet.sh runs failover and trace in "
                     "separate processes so each retries alone and "
                     "the tracing A/B measures a quiet process)")
+    ap.add_argument("--featurize", action="store_true",
+                    help="also run the device-side featurization row "
+                    "(serving_device_featurize): the same image "
+                    "featurize chain + model served host_featurize vs "
+                    "device_featurize, asserting matching outputs, "
+                    ">=3x fewer H2D bytes/request, and device "
+                    "examples/sec >= host (~30s)")
+    ap.add_argument("--featurize-only", action="store_true",
+                    help="run ONLY the device-side featurization row "
+                    "(what bin/smoke-featurize.sh invokes)")
     ap.add_argument("--autoscale", action="store_true",
                     help="also run the elasticity row "
                     "(serving_autoscale_ramp): a step-load ramp "
@@ -1861,7 +2097,9 @@ def main(argv=None) -> int:
         print(json.dumps(row), flush=True)
 
     def run():
-        if args.autoscale_only:
+        if args.featurize_only:
+            run_featurize_benches(emit)
+        elif args.autoscale_only:
             run_autoscale_benches(emit)
         elif args.fleet_only:
             run_fleet_benches(
@@ -1880,6 +2118,7 @@ def main(argv=None) -> int:
                 cold_start=not args.no_cold_start,
                 fleet=args.fleet,
                 autoscale=args.autoscale,
+                featurize=args.featurize,
             )
 
     if args.profile_dir:

@@ -18,6 +18,22 @@ padded batch. The optional sharded variant places each staged batch
 over the mesh data axis for multi-chip serving — same program, one
 compile per bucket, XLA inserts the collectives.
 
+**Device-side featurization** (``featurize=``): a second fitted
+pipeline — a pure-JAX featurize chain such as the ``ops/images``
+Convolver/LCS/FisherVector stacks — fused IN FRONT of the model into
+the same per-bucket program. Requests then stage **raw bytes** (e.g.
+``uint8`` images: 4× fewer H2D bytes than the f32 features), and the
+cast + featurize + predict all ride the single compiled dispatch; XLA
+fuses across the featurize/model boundary and the bucket cost model
+(MFU/roofline/goodput) automatically accounts for the fused FLOPs.
+This is the device-side counterpart of the batcher's ``host_featurize``
+seam — use that one for featurizers that can't trace (native/items
+code); use this one to kill the host-prep + upload bottleneck for
+chains that are already jax. Buckets stay row counts; the raw
+per-example shape rides the example spec exactly like any array input,
+and the ``keystone_serving_h2d_bytes_total`` counter makes the
+wire-bytes reduction a scraped fact.
+
 The dispatch path is factored into stage primitives so the staged lane
 pipeline (``serving/pipeline.py``) can run them on separate threads —
 ``host_stage`` (pad on host into a pooled reusable buffer),
@@ -77,6 +93,14 @@ class CompiledPipeline:
                (multi-chip serving). Buckets are rounded up to a
                multiple of the mesh's data-shard count so every shard
                gets equal rows.
+    featurize: optional fitted featurize pipeline fused IN FRONT of
+               ``pipeline`` inside every bucket program (device-side
+               featurization): callers stage RAW examples (e.g. uint8
+               images) and one compiled dispatch runs
+               ``pipeline(featurize(raw))``. Must be traceable
+               (array-mode, pure JAX) like ``pipeline`` itself; the
+               AOT-store fingerprint covers it (one featurizer's
+               cached executable can never serve another's).
     """
 
     def __init__(
@@ -90,12 +114,14 @@ class CompiledPipeline:
         metrics: Optional[ServingMetrics] = None,
         name: Optional[str] = None,
         aot_store: Any = "auto",
+        featurize: Any = None,
     ):
         if not buckets:
             raise ValueError("need at least one bucket")
         if any(b <= 0 for b in buckets):
             raise ValueError(f"buckets must be positive: {buckets}")
         self.pipeline = pipeline
+        self.featurize = featurize
         self.shard = shard
         self.mesh = mesh
         if shard:
@@ -165,14 +191,23 @@ class CompiledPipeline:
 
     def _make_jit(self, bucket: int) -> Callable:
         """A fresh polymorphic jit fn for ``bucket`` (shared builder of
-        the dispatch table and the off-spec side path)."""
+        the dispatch table and the off-spec side path). With a fused
+        featurize stage, the whole featurize∘model composition traces
+        into ONE program — XLA fuses across the boundary and the cast
+        from the raw wire dtype happens on device, inside it."""
         run = self.pipeline._batch_run
+        feat_run = (
+            self.featurize._batch_run
+            if self.featurize is not None else None
+        )
         metrics = self.metrics
 
         def staged(arr):
             # executes at TRACE time only — one increment per XLA
             # compile of this bucket, zero on compiled dispatches
             metrics.record_trace(bucket)
+            if feat_run is not None:
+                arr = feat_run(arr)
             return run(arr)
 
         return jax.jit(
@@ -294,6 +329,16 @@ class CompiledPipeline:
             raise faults.FaultInjected(
                 "engine.dispatch.error", engine=self.name, bucket=bucket
             )
+        # the wire-bytes fact: what this dispatch actually shipped to
+        # the device (padded rows included — padding is real traffic on
+        # the H2D path). nbytes is array METADATA (shape × itemsize),
+        # not a device read, so this stays sync-free; device-featurize
+        # engines stage raw uint8 here and the counter is how the ~4×
+        # reduction over f32 features becomes a scraped fact.
+        h2d_bytes = sum(
+            int(getattr(a, "nbytes", 0))
+            for a in jax.tree_util.tree_leaves(staged)
+        )
         fn = self._fn(bucket)
         try:
             out = fn(staged)
@@ -323,7 +368,7 @@ class CompiledPipeline:
                     "side jit path", self.name, bucket,
                 )
             out = self._side_fn(bucket)(staged)
-        self.metrics.record_dispatch(bucket, rows)
+        self.metrics.record_dispatch(bucket, rows, h2d_bytes=h2d_bytes)
         return out
 
     # -- serving entry points ----------------------------------------------
@@ -434,14 +479,20 @@ class CompiledPipeline:
                 f"unknown bucket(s) {unknown} (have {self.buckets})"
             )
         store = self._resolve_aot_store()
-        token = identity = None
+        token = feat_token = identity = None
         if store is not None:
             from keystone_tpu.serving import aot as aot_lib
 
             try:
-                # both warmup-invariant: hash the model and probe the
-                # runtime once, not once per bucket
+                # all warmup-invariant: hash the model (and the fused
+                # featurize stage, when one is configured — its
+                # parameters are constants inside the serialized
+                # program exactly like the model's weights, so one
+                # featurizer's cached executable must never serve
+                # another's) and probe the runtime once, not per bucket
                 token = aot_lib.pipeline_token(self.pipeline)
+                if self.featurize is not None:
+                    feat_token = aot_lib.pipeline_token(self.featurize)
                 identity = aot_lib.runtime_identity()
             except Exception:
                 # a pipeline whose operators can't be fingerprinted
@@ -464,6 +515,7 @@ class CompiledPipeline:
                     specs, self.buckets, b,
                     donate=self.donate, shard=self.shard,
                     model_token=token, identity=identity,
+                    featurize_token=feat_token,
                 )
                 # the zero-cold-start path: install the serialized
                 # executable BEFORE any trace of this bucket can

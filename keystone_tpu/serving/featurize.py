@@ -1,0 +1,73 @@
+"""Reference device-side featurize chain for serving.
+
+``CompiledPipeline(featurize=...)`` fuses any fitted pure-JAX pipeline
+in front of the model; this module provides the canonical image chain
+the ``--device-featurize`` gateway mode, the ``serving_device_featurize``
+bench row, and the smoke/tests all share — kept OUT of the benchmark
+module so the production CLI path doesn't depend on bench code. Real
+deployments build their own featurize ``FittedPipeline`` from the
+``ops/images`` nodes (Convolver, LCS, FisherVector, ...) the same way.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def build_featurize_pipeline(
+    img: int = 16,
+    channels: int = 3,
+    filters: int = 96,
+    conv_size: int = 5,
+    pool_stride: int = 6,
+    pool_size: int = 6,
+    seed: int = 7,
+) -> Tuple[object, int]:
+    """A pure-JAX image featurize chain — raw ``(img, img, C)`` uint8
+    in, ``(F,)`` f32 features out: PixelScaler → Convolver (patch
+    normalization folded around one XLA conv) → SymmetricRectifier →
+    sum-Pooler → channel-major ImageVectorizer, the
+    RandomPatchCifar-style dense-conv stack from ``ops/images``.
+    Returns ``(fitted_featurize, feature_dim)``. The default geometry
+    is the device-featurize demo/bench shape: 16·16·3 = 768 raw uint8
+    bytes per example featurize to 768 f32 features = 3072 bytes, so
+    shipping raw instead of featurized is a 4× H2D reduction."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.images.core import (
+        Convolver,
+        ImageVectorizer,
+        PixelScaler,
+        Pooler,
+        SymmetricRectifier,
+    )
+
+    rng = np.random.default_rng(seed)
+    packed = jnp.asarray(
+        rng.standard_normal(
+            (filters, conv_size * conv_size * channels)
+        ).astype(np.float32) * 0.1
+    )
+    pipe = None
+    for node in (
+        PixelScaler(),
+        Convolver(packed, img, img, channels),
+        SymmetricRectifier(),
+        Pooler(stride=pool_stride, pool_size=pool_size),
+        ImageVectorizer(),
+    ):
+        pipe = node.to_pipeline() if pipe is None else pipe.and_then(node)
+    fitted = pipe.to_pipeline().fit()
+    feat_dim = int(
+        np.asarray(
+            fitted._batch_run(
+                jnp.zeros((1, img, img, channels), jnp.uint8)
+            )
+        ).shape[-1]
+    )
+    return fitted, feat_dim
+
+
+__all__ = ["build_featurize_pipeline"]

@@ -82,6 +82,11 @@ class ServingMetrics:
         # engine-wide number the summary/bench read)
         self.examples = Counter()
         self.padded_rows = Counter()
+        # bytes actually staged to the device, per bucket (padding
+        # included — padding rides the H2D path like any row). The
+        # device-featurize win — raw uint8 on the wire instead of f32
+        # features — is this counter's ratio, not a claim.
+        self.h2d_bytes = Counter()
         # bucket -> static XLA cost model ({flops, bytes_accessed,
         # temp_bytes, ...}), registered once at warmup by
         # CompiledPipeline; absent on backends without cost analysis
@@ -144,17 +149,25 @@ class ServingMetrics:
         self.compiles.inc(bucket)
 
     def record_dispatch(
-        self, bucket: int, n_valid: int, seconds: Optional[float] = None
+        self,
+        bucket: int,
+        n_valid: int,
+        seconds: Optional[float] = None,
+        h2d_bytes: Optional[int] = None,
     ) -> None:
         """One compiled-program dispatch: counters + rate events.
         ``seconds``, when given, is a completion-timed wall number and
         feeds ``dispatch_latency`` directly (callers that only know the
         enqueue time use ``record_dispatch_enqueue`` and record the
-        completion number at their sync point)."""
+        completion number at their sync point). ``h2d_bytes`` is the
+        staged input tree's byte footprint — what this dispatch shipped
+        host-to-device, padding included."""
         padded = bucket - n_valid
         self.dispatches.inc(bucket)
         self.examples.inc(bucket, n_valid)
         self.padded_rows.inc(bucket, padded)
+        if h2d_bytes:
+            self.h2d_bytes.inc(bucket, int(h2d_bytes))
         self.request_sizes.inc(n_valid)
         # modeled device work for this dispatch: the bucket program's
         # static cost is paid whether rows are valid or padding
@@ -450,6 +463,11 @@ class ServingMetrics:
             },
             "examples": self.examples.total,
             "padded_rows": self.padded_rows.total,
+            "h2d_bytes_total": self.h2d_bytes.total,
+            "h2d_bytes_per_example": (
+                round(self.h2d_bytes.total / self.examples.total, 1)
+                if self.examples.total else None
+            ),
             "padding_efficiency": (
                 round(eff, 4) if eff is not None else None
             ),
@@ -745,6 +763,16 @@ class ServingMetrics:
                     [
                         Sample("", {"engine": label, "bucket": str(b)}, v)
                         for b, v in sorted(m.padded_rows.snapshot().items())
+                    ],
+                ),
+                MetricFamily(
+                    "keystone_serving_h2d_bytes_total", "counter",
+                    "bytes staged host-to-device per dispatch, by "
+                    "bucket (padding included; raw-on-the-wire "
+                    "device-featurize engines show the reduction here)",
+                    [
+                        Sample("", {"engine": label, "bucket": str(b)}, v)
+                        for b, v in sorted(m.h2d_bytes.snapshot().items())
                     ],
                 ),
                 MetricFamily(

@@ -292,6 +292,145 @@ def test_bucket_key_varies_by_every_field():
     assert other_spec != base
 
 
+def test_bucket_key_featurize_token_isolates():
+    """Fused device-featurize programs must never share an entry with
+    the unfused model, nor with the same model fused behind a DIFFERENT
+    featurizer — the featurize parameters are constants inside the
+    serialized executable exactly like the model weights."""
+    specs = [((8, 8, 3), np.uint8)]
+    args = dict(specs=specs, buckets=(4,), bucket=4, donate=False,
+                shard=False, model_token="m")
+    plain, plain_meta = aot.bucket_key(**args)
+    fused1, meta1 = aot.bucket_key(**args, featurize_token="f1")
+    fused2, meta2 = aot.bucket_key(**args, featurize_token="f2")
+    assert len({plain, fused1, fused2}) == 3
+    # unfused meta carries NO featurize key: pre-featurize store
+    # entries keep their fingerprints across the upgrade (no
+    # fleet-wide cold start), while fused metas pin their token
+    assert "featurize_token" not in plain_meta
+    assert (meta1["featurize_token"], meta2["featurize_token"]) == (
+        "f1", "f2"
+    )
+
+
+# -- device-featurize isolation --------------------------------------------
+
+def _fused_pair():
+    """Two featurize chains differing only in filter weights, plus a
+    model sized to their shared output dim."""
+    from keystone_tpu.serving.bench import build_pipeline
+    from keystone_tpu.serving.featurize import build_featurize_pipeline
+
+    feat1, feat_d = build_featurize_pipeline(
+        img=8, channels=3, filters=4, conv_size=3,
+        pool_stride=4, pool_size=4, seed=3,
+    )
+    feat2, _ = build_featurize_pipeline(
+        img=8, channels=3, filters=4, conv_size=3,
+        pool_stride=4, pool_size=4, seed=4,
+    )
+    model = build_pipeline(d=feat_d, hidden=8, depth=2)
+    return feat1, feat2, model, feat_d
+
+
+def _fused_engine(model, feat, store, name):
+    eng = model.compiled(
+        buckets=(4,), featurize=feat, aot_store=store, name=name
+    )
+    eng.warmup(example=jnp.zeros((8, 8, 3), jnp.uint8))
+    return eng
+
+
+def test_featurize_roundtrip_and_two_featurizers_never_collide(tmp_path):
+    """The isolation contract end to end: a fused engine's entry hits
+    for the SAME featurizer (zero compiles, identical outputs) and
+    misses for a different one — which recompiles and serves its own
+    correct answers, never the cached featurizer's."""
+    feat1, feat2, model, feat_d = _fused_pair()
+    store = make_store(tmp_path)
+    raw = np.random.default_rng(5).integers(
+        0, 256, (3, 8, 8, 3), dtype=np.uint8
+    )
+
+    e1 = _fused_engine(model, feat1, store, "aot-dfz-1")
+    assert statuses(e1) == {4: "saved"}
+    out1 = np.asarray(e1.apply(raw, sync=True))
+
+    e2 = _fused_engine(model, feat1, store, "aot-dfz-2")
+    assert statuses(e2) == {4: "hit"}
+    assert e2.metrics.compile_count == 0
+    np.testing.assert_array_equal(
+        np.asarray(e2.apply(raw, sync=True)), out1
+    )
+
+    # different featurizer weights -> different fingerprint -> MISS
+    # (never a hit on feat1's executable), fresh compile, own answers
+    e3 = _fused_engine(model, feat2, store, "aot-dfz-3")
+    assert statuses(e3) == {4: "saved"}
+    assert e3.metrics.compile_count == 1
+    out3 = np.asarray(e3.apply(raw, sync=True))
+    want3 = np.asarray(
+        model._batch_run(feat2._batch_run(jnp.asarray(raw)))
+    )[:3]
+    np.testing.assert_allclose(out3, want3, rtol=1e-4, atol=1e-6)
+    assert not np.allclose(out3, out1)
+
+    # and the unfused model shares nothing with the fused entries
+    entries_before = set(store.entries())
+    plain = model.compiled(buckets=(4,), aot_store=store, name="aot-dfz-p")
+    plain.warmup(example=jnp.zeros((feat_d,), jnp.float32))
+    assert statuses(plain) == {4: "saved"}
+    assert set(store.entries()) > entries_before
+
+
+def test_featurize_cross_load_falls_back_counted(tmp_path):
+    """A cross-load attempt — feat1's entry bytes sitting at feat2's
+    key (filename collision, copy mistake, hostile store) — is
+    rejected on the meta re-check BEFORE anything is unpickled:
+    counted as an error, recompiled, correct answer."""
+    from keystone_tpu.serving.aot import pipeline_token, runtime_identity
+
+    feat1, feat2, model, _feat_d = _fused_pair()
+    store = make_store(tmp_path)
+    e1 = _fused_engine(model, feat1, store, "aot-xl-1")
+    assert statuses(e1) == {4: "saved"}
+
+    specs = [((8, 8, 3), np.dtype(np.uint8))]
+    ident = runtime_identity()
+    key1, _ = aot.bucket_key(
+        specs, e1.buckets, 4, donate=e1.donate, shard=False,
+        model_token=pipeline_token(model), identity=ident,
+        featurize_token=pipeline_token(feat1),
+    )
+    key2, _ = aot.bucket_key(
+        specs, e1.buckets, 4, donate=e1.donate, shard=False,
+        model_token=pipeline_token(model), identity=ident,
+        featurize_token=pipeline_token(feat2),
+    )
+    # plant feat1's entry at feat2's key
+    import shutil
+
+    shutil.copyfile(store.path_for(key1), store.path_for(key2))
+    errors_before = store.errors
+
+    e2 = _fused_engine(model, feat2, store, "aot-xl-2")
+    # the planted entry was rejected (stored meta disagrees with the
+    # requested fingerprint), the error was counted, and the engine
+    # recompiled its own program — never a wrong answer
+    assert statuses(e2)[4] in ("error",)
+    assert store.errors > errors_before
+    assert e2.metrics.compile_count == 1
+    raw = np.random.default_rng(6).integers(
+        0, 256, (2, 8, 8, 3), dtype=np.uint8
+    )
+    want = np.asarray(
+        model._batch_run(feat2._batch_run(jnp.asarray(raw)))
+    )[:2]
+    np.testing.assert_allclose(
+        np.asarray(e2.apply(raw, sync=True)), want, rtol=1e-4, atol=1e-6
+    )
+
+
 # -- observability ---------------------------------------------------------
 
 def test_metrics_families_on_scrape(tmp_path, fitted):

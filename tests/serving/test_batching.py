@@ -188,3 +188,88 @@ def test_error_propagates_to_futures(fitted):
             fut.result(timeout=30)
         good = mb.submit(batch(1, seed=2)[0])  # new window, accepted
         assert np.asarray(good.result(timeout=30)).shape == (3,)
+
+
+def test_items_mode_array_items_segregate_by_shape(fitted):
+    """The items-mode window-homogeneity fix: with a host featurizer
+    installed, ARRAY items key windows by (shape, dtype) instead of
+    collapsing every submission into one stream — mixed-size raw
+    inputs coalesce per shape, so the hook always sees a
+    shape-homogeneous window (no ragged stacks, no padding every
+    window to the largest item ever seen)."""
+    seen_windows = []
+    lock = threading.Lock()
+
+    def featurize(items):
+        shapes = {np.asarray(it).shape for it in items}
+        with lock:
+            seen_windows.append(shapes)
+        assert len(shapes) == 1, f"ragged window: {shapes}"
+        (shape,) = shapes
+        if shape == (2, D):
+            # "large" items fold their two halves together
+            return np.stack(
+                [np.asarray(it, np.float32).mean(axis=0) for it in items]
+            )
+        return np.stack([np.asarray(it, np.float32) for it in items])
+
+    engine = CompiledPipeline(fitted, buckets=(4, 16))
+    engine.warmup(example=jnp.zeros((D,), jnp.float32))
+    n = 6
+    small = batch(n, seed=21)
+    big = np.stack([batch(2, seed=30 + i) for i in range(n)])
+    futures = {}
+    with MicroBatcher(
+        engine, max_delay_ms=100.0, host_featurize=featurize
+    ) as mb:
+        for i in range(n):  # strictly interleaved
+            futures[("small", i)] = mb.submit(small[i])
+            futures[("big", i)] = mb.submit(big[i])
+        rows = {
+            k: np.asarray(f.result(timeout=30))
+            for k, f in futures.items()
+        }
+    want_small = np.asarray(
+        fitted.apply(Dataset.from_array(jnp.asarray(small))).array()
+    )
+    want_big = np.asarray(
+        fitted.apply(
+            Dataset.from_array(jnp.asarray(big.mean(axis=1)))
+        ).array()
+    )
+    for i in range(n):
+        np.testing.assert_allclose(
+            rows[("small", i)], want_small[i], rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            rows[("big", i)], want_big[i], rtol=1e-5, atol=1e-6
+        )
+    # both shape streams still coalesced (not 2n solo windows), and no
+    # window ever mixed shapes (the featurize assert above is the proof)
+    assert engine.metrics.max_coalesced >= 2
+    assert all(len(s) == 1 for s in seen_windows)
+
+
+def test_items_mode_non_array_items_share_one_stream(fitted):
+    """Non-array raw items (lists/strings/records) still have no
+    stable per-item spec: they keep the single shared items stream and
+    the hook owns homogeneity — the pre-fix contract, unchanged."""
+    calls = []
+
+    def featurize(items):
+        calls.append(len(items))
+        return np.stack(
+            [np.asarray(it, np.float32) for it in items]
+        )
+
+    engine = CompiledPipeline(fitted, buckets=(8,))
+    engine.warmup(example=jnp.zeros((D,), jnp.float32))
+    items = [list(batch(1, seed=50 + i)[0]) for i in range(6)]
+    with MicroBatcher(
+        engine, max_delay_ms=100.0, host_featurize=featurize
+    ) as mb:
+        futs = [mb.submit(it) for it in items]
+        for f in futs:
+            f.result(timeout=30)
+    # all six lists coalesced into shared windows (one stream)
+    assert engine.metrics.max_coalesced >= 2

@@ -508,3 +508,56 @@ class TestHostBufferPool:
         gen, _ = pool.acquire("k", lambda: np.zeros(4))
         pool.release("k", gen, None)  # window died pre-attachment
         assert not pool._free.get("k")
+
+
+def test_uint8_staging_pool_reuse_and_byte_accounting():
+    """Device-featurize lanes stage RAW uint8: the per-(bucket, spec)
+    pool keys carry the uint8 dtype, steady-state windows reuse the
+    pooled raw buffers (zero allocation growth past the cap), and
+    both the pool's byte ledger and the staging-bytes gauge account
+    the one-byte-per-element footprint exactly (the f32 ledger would
+    be 4x this for the same element count)."""
+    from keystone_tpu.serving.bench import build_pipeline
+    from keystone_tpu.serving.featurize import build_featurize_pipeline
+
+    img, ch = 8, 3
+    feat, feat_d = build_featurize_pipeline(
+        img=img, channels=ch, filters=4, conv_size=3,
+        pool_stride=4, pool_size=4, seed=3,
+    )
+    model = build_pipeline(d=feat_d, hidden=8, depth=2)
+    engine = model.compiled(
+        buckets=(4,), featurize=feat, aot_store=False, name="u8-pool"
+    )
+    engine.warmup(example=jnp.zeros((img, img, ch), jnp.uint8))
+    rng = np.random.default_rng(9)
+    n_windows = 10
+    with MicroBatcher(
+        engine, max_delay_ms=100.0, max_batch=4, pipeline_depth=2
+    ) as mb:
+        pool = mb._pipeline.pool
+        for k in range(n_windows):
+            raws = rng.integers(0, 256, (4, img, img, ch), dtype=np.uint8)
+            for f in [mb.submit(r) for r in raws]:
+                f.result(timeout=60)
+        allocations = pool.allocations
+        # the pool key pins the raw uint8 spec, and its cached size is
+        # the raw byte footprint: bucket rows x img x img x ch x 1 B
+        raw_buf_bytes = 4 * img * img * ch
+        keys = list(pool._key_bytes)
+        assert len(keys) == 1
+        (bucket, _treedef, leaf_specs) = keys[0]
+        assert bucket == 4
+        assert leaf_specs == (((img, img, ch), "|u1"),)
+        assert pool._key_bytes[keys[0]] == raw_buf_bytes
+        assert pool.staging_bytes == raw_buf_bytes * allocations
+        assert engine.metrics.staging_bytes == pool.staging_bytes
+    # sequential awaited windows recycle buffers: the no-growth bound
+    # is the pool cap (depth+1 per key), not per-window growth
+    assert allocations <= pool.max_per_key, (
+        f"{allocations} uint8 staging allocations for {n_windows} windows"
+    )
+    assert engine.metrics.windows.total == n_windows
+    # and what went over the wire was the raw uint8 footprint: one
+    # byte per element, a quarter of what the same elements cost in f32
+    assert engine.metrics.h2d_bytes.total == n_windows * raw_buf_bytes
