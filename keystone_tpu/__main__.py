@@ -153,7 +153,9 @@ def main(argv=None) -> int:
         print("  serve-bench  (serving engine benchmarks; see "
               "keystone_tpu/serving/bench.py)")
         print("  serve-gateway  (HTTP request plane over the bench "
-              "pipeline; keystone_tpu/gateway/)")
+              "pipeline; keystone_tpu/gateway/. --shard-model serves "
+              "the model mesh-sharded over the local devices — "
+              "keystone_tpu/serving/sharding.py)")
         print("  serve-router  (fleet tier: cross-host router over N "
               "serve-gateway replicas — replica registry with "
               "--replica URLs + POST /registerz self-registration, "

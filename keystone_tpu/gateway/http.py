@@ -715,6 +715,21 @@ def main(argv=None) -> int:
     ap.add_argument("--img", type=int, default=16,
                     help="raw image edge length under "
                     "--device-featurize")
+    ap.add_argument("--shard-model", action="store_true",
+                    help="mesh-shard the MODEL over the local devices "
+                    "(serving/sharding.py): the process mesh is pinned "
+                    "to (data=1, model=<all devices>), the default "
+                    "partition rules split every weight matrix over "
+                    "the model axis, and each lane engine's bucket "
+                    "programs run GSPMD-partitioned with the params as "
+                    "sharded arguments — models bigger than one chip's "
+                    "HBM serve on the mesh. Typically combined with "
+                    "--lanes 1 (each lane places its own param copy)")
+    ap.add_argument("--mesh-model", type=int, default=None,
+                    metavar="N",
+                    help="model-axis size under --shard-model "
+                    "(default: all local devices); remaining devices "
+                    "go to the data axis")
     ap.add_argument("--no-cache", action="store_true",
                     help="run with NO persistence: skips both the "
                     "persistent XLA compile cache and the AOT "
@@ -755,6 +770,16 @@ def main(argv=None) -> int:
     fitted = build_pipeline(d=args.d, hidden=args.hidden, depth=args.depth)
     if not args.device_featurize:
         warmup_example = jnp.zeros((args.d,), jnp.float32)
+    if args.shard_model:
+        # pin the process mesh so EVERY engine generation (initial
+        # build, rebuckets, warm-pool swaps) places over the same
+        # (data, model) topology
+        import jax
+
+        from keystone_tpu.parallel import mesh as mesh_lib
+
+        n_model = args.mesh_model or len(jax.devices())
+        mesh_lib.set_mesh(mesh_lib.make_mesh(n_model=n_model))
     gateway = Gateway(
         fitted,
         buckets=tuple(int(b) for b in args.buckets.split(",")),
@@ -762,6 +787,7 @@ def main(argv=None) -> int:
         max_delay_ms=args.max_delay_ms,
         pipeline_depth=args.pipeline_depth,
         device_featurize=featurize,
+        param_sharding=True if args.shard_model else None,
         warmup_example=warmup_example,
         max_pending=args.max_pending,
         default_deadline_ms=args.deadline_ms,

@@ -104,6 +104,18 @@ class Gateway:
                        host-prep stage (or inline when serial), so
                        tokenizer/featurizer front-ends burn host cores
                        while the device computes the previous window.
+    param_sharding:    shard the MODEL over the process mesh's model
+                       axis (serving/sharding.py): ``True`` resolves
+                       the default rule set, a rules sequence or
+                       ``{name: spec}`` dict partitions explicitly.
+                       Every engine generation the factory builds —
+                       initial lanes, rebucket replacements, warm-pool
+                       swaps — carries the same partitioning, placed
+                       over the mesh current at build time (serving
+                       CLIs pin it process-wide with
+                       ``mesh.set_mesh``). Each lane places its OWN
+                       copy of the sharded params, so bigger-than-one-
+                       chip models are typically served ``n_lanes=1``.
     device_featurize:  optional fitted featurize pipeline fused into
                        every lane engine's bucket programs IN FRONT of
                        ``fitted`` (``CompiledPipeline(featurize=...)``):
@@ -158,6 +170,7 @@ class Gateway:
         pipeline_depth: int = 2,
         host_featurize=None,
         device_featurize=None,
+        param_sharding=None,
         max_pending: int = 1024,
         default_deadline_ms: Optional[float] = None,
         maintenance_interval_s: Optional[float] = None,
@@ -184,8 +197,10 @@ class Gateway:
         self._warmup_example = warmup_example
         # fused into every engine generation the factory builds —
         # initial lanes, rebucket replacements, and warm-pool swaps all
-        # carry the same device-side featurize stage
+        # carry the same device-side featurize stage and the same
+        # model-sharding rules
         self._device_featurize = device_featurize
+        self._param_sharding = param_sharding
         self._rebucket_k = rebucket_k or len(self._buckets)
         self.metrics = GatewayMetrics(registry=registry, gateway=name)
         self.pool = EnginePool(
@@ -282,6 +297,7 @@ class Gateway:
             return self.fitted.compiled(
                 buckets=buckets, name=lane_name,
                 featurize=self._device_featurize,
+                param_sharding=self._param_sharding,
             )
 
         return factory

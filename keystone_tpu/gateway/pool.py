@@ -14,7 +14,12 @@ factory builds each lane engine with
 ``CompiledPipeline(featurize=...)``, so every generation (initial
 build, rebucket replacements, warm-pool swaps) carries the fused
 featurize∘model programs and lanes stage raw bytes — bare-pool users
-bake ``featurize=`` into their own factory the same way. The pool adds
+bake ``featurize=`` into their own factory the same way. Model
+sharding rides the factory identically
+(``CompiledPipeline(param_sharding=...)``; the Gateway's factory
+threads its ``param_sharding=`` through): each lane's engine places
+its OWN copy of the sharded params over the mesh, so
+bigger-than-one-chip models are typically served with one lane. The pool adds
 the three things a replica set needs beyond execution:
 
 - **least-loaded routing** — ``submit()`` hands each request to the

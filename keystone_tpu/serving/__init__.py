@@ -12,7 +12,16 @@ production inference engine:
   in front of the model inside every bucket program — device-side
   featurization: raw uint8 staged (~4× fewer H2D bytes than f32
   features, counted by ``keystone_serving_h2d_bytes_total``), cast +
-  featurize + predict in one dispatch.
+  featurize + predict in one dispatch. ``param_sharding=`` shards the
+  MODEL over the mesh's model axis (see sharding.py below) — models
+  bigger than one chip's HBM serve on the mesh.
+- ``sharding.py``: the declarative model-sharding layer —
+  ``match_partition_rules`` (regex over the fitted pipeline's named
+  param pytree -> ``PartitionSpec`` tree), ``make_shard_fns`` /
+  ``make_gather_fns`` placement callables, a default rule set for the
+  repo's solver outputs (weight matrices split on the output axis,
+  biases replicated), and the ``ParamBinder`` functionalization seam
+  that turns params into sharded program arguments.
 - ``MicroBatcher`` (batching.py): adaptive micro-batching — a
   thread-safe queue that coalesces single-example ``submit()`` requests
   into spec-homogeneous windows (interleaved request streams with
@@ -59,15 +68,27 @@ from keystone_tpu.serving.pipeline import (
     HostFeaturize,
     LanePipeline,
 )
+from keystone_tpu.serving.sharding import (
+    DEFAULT_RULES,
+    make_gather_fns,
+    make_shard_fns,
+    match_partition_rules,
+    named_params,
+)
 
 __all__ = [
     "AotStore",
     "CompiledPipeline",
+    "DEFAULT_RULES",
     "HostBufferPool",
     "HostFeaturize",
     "LanePipeline",
     "MicroBatcher",
     "ServingMetrics",
+    "make_gather_fns",
+    "make_shard_fns",
+    "match_partition_rules",
+    "named_params",
     "padding_waste",
     "suggest_buckets",
 ]

@@ -244,6 +244,7 @@ def bucket_key(
     model_token: str,
     identity: Optional[Dict[str, Any]] = None,
     featurize_token: Optional[str] = None,
+    sharding_token: Optional[str] = None,
 ) -> Tuple[str, Dict[str, Any]]:
     """Fingerprint one bucket program. Returns ``(key, meta)`` where
     ``key`` is the store filename stem and ``meta`` is the full
@@ -255,7 +256,15 @@ def bucket_key(
     ``featurize=``), or None for plain model programs: the featurize
     parameters are constants inside the serialized executable just like
     the model weights, so fused and unfused programs — and programs
-    fused with DIFFERENT featurizers — must never share an entry."""
+    fused with DIFFERENT featurizers — must never share an entry.
+    ``sharding_token`` is ``serving/sharding.sharding_token``'s digest
+    of a model-sharded engine's resolved partition-spec tree + mesh
+    topology, or None for replicated programs: a mesh-sharded
+    executable is a structurally different program (GSPMD-partitioned,
+    params as arguments) and must never share an entry with a
+    replicated one — while replicated programs' fingerprints stay
+    byte-identical to pre-sharding stores (no fleet-wide cold start on
+    upgrade)."""
     meta: Dict[str, Any] = {
         "format": STORE_FORMAT,
         "specs": [
@@ -274,6 +283,13 @@ def bucket_key(
         **(
             {"featurize_token": featurize_token}
             if featurize_token is not None else {}
+        ),
+        # same stamped-only-when-set discipline as featurize_token:
+        # unconditionally writing None here would shift every
+        # replicated key and cold-start every existing store
+        **(
+            {"sharding_token": sharding_token}
+            if sharding_token is not None else {}
         ),
         **(identity if identity is not None else runtime_identity()),
     }

@@ -48,6 +48,23 @@
   bytes/request <= 1/3 of the host path (off the engines' own
   ``keystone_serving_h2d_bytes_total`` counters), and sustained
   device-path examples/sec >= host. Headline: device examples/sec.
+- ``serving_sharded_vs_replicated`` — the model-axis row
+  (``--shard``/``--shard-only``; run by ``bin/smoke-shard.sh``): the
+  SAME fitted model served mesh-sharded (one lane,
+  ``param_sharding=True`` over a (1, N)-device mesh —
+  serving/sharding.py's default partition rules split every weight
+  matrix over the model axis and the params become sharded program
+  arguments) vs N replicated lanes, swept over model sizes. Asserted:
+  sharded outputs allclose to replicated at every size both can
+  serve, and the **over-one-device-budget model** — whose total
+  parameter bytes exceed the row's per-chip budget, so the replicated
+  path refuses to build — serves sharded with its measured
+  max-per-device parameter bytes (read off the placed buffers'
+  actual shards, not the specs) inside the budget. The row JSON
+  carries the crossover curve: per model size, parameter MB,
+  sharded vs replicated examples/sec. Headline: sharded
+  examples/sec on the over-budget model. Needs >= 2 devices
+  (``XLA_FLAGS=--xla_force_host_platform_device_count=8`` on CPU).
 - ``serving_chaos_lane_kill`` / ``serving_chaos_prep_stall`` — the
   chaos-harness regression rows (``--chaos``; run by
   ``bin/smoke-chaos.sh``): sustained open-loop load through a full
@@ -852,6 +869,223 @@ def bench_device_featurize(
             "device_compiles": dev["compiles"],
             "outputs_allclose": True,
             "max_abs_diff": maxdiff,
+        },
+    )
+
+
+def bench_sharded_vs_replicated(
+    emit,
+    sizes: Sequence[int] = (128, 256, 512),
+    big_d: int = 1024,
+    depth: int = 3,
+    buckets: Sequence[int] = (8, 32),
+    n_requests: int = 192,
+    n_threads: int = 8,
+    n_check: int = 16,
+    replicated_lanes: int = 2,
+    device_budget_mb: float = 6.0,
+) -> None:
+    """``serving_sharded_vs_replicated`` — the model axis A/B: the
+    same fitted model served
+
+    - **replicated**: ``replicated_lanes`` shared-nothing lanes, each
+      holding the FULL parameter set (the pre-sharding scaling story,
+      and what a per-chip HBM budget caps);
+    - **sharded**: ONE lane whose engine runs ``param_sharding=True``
+      over a ``(data=1, model=N)`` mesh spanning every local device —
+      the default rules split each weight matrix over the model axis,
+      the params ride as sharded program arguments, and each device
+      holds only its shard.
+
+    Swept over ``sizes`` (square ``depth``-layer models, parameter
+    bytes ~ ``depth * d^2 * 4``) plus ``big_d``, sized to exceed the
+    row's **per-device parameter budget** (``device_budget_mb`` —
+    virtual CPU devices have no real HBM wall, so the budget plays
+    the chip; on real TPUs it would be the HBM limit the
+    device-memory sampler reports). Per size the row asserts (raises,
+    never ``assert``):
+
+    - sharded outputs allclose to the replicated path's;
+    - the big model's TOTAL parameter bytes exceed the budget (the
+      replicated path is refused — recorded ``over_budget``, exactly
+      what a real per-chip OOM would make of it) while its measured
+      per-device placed-parameter bytes — summed over the actual
+      shard buffers, ``sharding.placed_shard_bytes`` — fit, and it
+      SERVES: the capability the replicated stack lacks outright;
+    - every size both paths can serve contributes a crossover-curve
+      entry (params_mb, sharded/replicated examples/sec) to the row
+      JSON — on shared-core virtual CPU devices the rates measure
+      dispatch/collective overhead rather than real chip scaling, so
+      the curve is reported, not asserted.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.gateway import Gateway
+    from keystone_tpu.parallel import mesh as mesh_lib
+    from keystone_tpu.serving import sharding as sharding_lib
+
+    n_devices = len(jax.devices())
+    if n_devices < 2:
+        raise RuntimeError(
+            "serving_sharded_vs_replicated needs >= 2 devices; on CPU "
+            "run under XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    budget = int(device_budget_mb * 1e6)
+    mesh = mesh_lib.make_mesh(n_data=1, n_model=n_devices)
+
+    def drive(gw, inputs):
+        served = [None] * len(inputs)
+        errors = []
+
+        def client(tid):
+            try:
+                for i in range(tid, len(inputs), n_threads):
+                    served[i] = np.asarray(
+                        gw.predict(inputs[i]).result(timeout=120)
+                    )
+            except Exception as e:
+                errors.append(e)
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=client, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(
+                f"shard bench client failed on {gw.name}: "
+                f"{errors[0]!r}"
+            ) from errors[0]
+        return time.perf_counter() - t0, served
+
+    def measure(gw, inputs):
+        drive(gw, inputs[: len(inputs) // 2])  # unmeasured warm pass
+        dt = float("inf")
+        for _ in range(2):
+            dt = min(dt, drive(gw, inputs)[0])
+        return len(inputs) / dt
+
+    curve = []
+    rng = np.random.default_rng(17)
+    for d in tuple(sizes) + (int(big_d),):
+        model = build_pipeline(d=d, hidden=d, depth=depth)
+        total = sharding_lib.params_nbytes(
+            sharding_lib.named_params(model)
+        )
+        fits_one_device = total <= budget
+        check = [
+            rng.standard_normal((d,)).astype(np.float32)
+            for _ in range(n_check)
+        ]
+        raws = [
+            rng.standard_normal((d,)).astype(np.float32)
+            for _ in range(n_requests)
+        ]
+        entry = {
+            "d": d,
+            "params_mb": round(total / 1e6, 2),
+            "fits_one_device": fits_one_device,
+        }
+        with mesh_lib.use_mesh(mesh):
+            gw_s = Gateway(
+                model, buckets=buckets, n_lanes=1, max_delay_ms=2.0,
+                param_sharding=True,
+                warmup_example=jnp.zeros((d,), jnp.float32),
+                name=f"bench-shard-{d}",
+            )
+        gw_r = None
+        if fits_one_device:
+            gw_r = Gateway(
+                model, buckets=buckets, n_lanes=replicated_lanes,
+                max_delay_ms=2.0,
+                warmup_example=jnp.zeros((d,), jnp.float32),
+                name=f"bench-repl-{d}",
+            )
+        else:
+            # the capability gap itself: a replicated lane needs the
+            # FULL parameter set resident per device, and this model's
+            # exceeds the per-device budget — exactly what a real
+            # per-chip HBM wall makes of it
+            entry["replicated"] = "over_budget"
+        try:
+            engine = gw_s.pool.lanes[0].engine
+            if not engine.model_sharded:
+                raise RuntimeError(
+                    f"d={d}: the sharded gateway's engine is not "
+                    "model-sharded"
+                )
+            per_dev = sharding_lib.placed_shard_bytes(
+                engine._placed_params
+            )
+            max_dev = max(per_dev.values())
+            entry["max_device_params_mb"] = round(max_dev / 1e6, 2)
+            if max_dev > budget:
+                raise RuntimeError(
+                    f"d={d}: sharded per-device parameter bytes "
+                    f"{max_dev} exceed the {budget}-byte budget — the "
+                    "partition rules did not actually split the model"
+                )
+            outs_s = drive(gw_s, check)[1]
+            if gw_r is not None:
+                outs_r = drive(gw_r, check)[1]
+                for i, (a, b) in enumerate(zip(outs_s, outs_r)):
+                    if not np.allclose(a, b, rtol=1e-4, atol=1e-5):
+                        raise RuntimeError(
+                            f"d={d}: sharded output {i} diverges from "
+                            f"the replicated path (max abs diff "
+                            f"{np.abs(a - b).max():.3e})"
+                        )
+                entry["outputs_allclose"] = True
+                entry["replicated_examples_per_sec"] = round(
+                    measure(gw_r, raws), 1
+                )
+            entry["sharded_examples_per_sec"] = round(
+                measure(gw_s, raws), 1
+            )
+        finally:
+            gw_s.close()
+            if gw_r is not None:
+                gw_r.close()
+        curve.append(entry)
+
+    big = curve[-1]
+    if big["fits_one_device"]:
+        raise RuntimeError(
+            f"big_d={big_d} fits the {device_budget_mb} MB device "
+            "budget — the over-budget leg measured nothing; raise "
+            "big_d or lower the budget"
+        )
+    if "sharded_examples_per_sec" not in big:
+        raise RuntimeError(
+            "the over-budget model did not serve on the sharded path"
+        )
+    if not all(
+        e.get("outputs_allclose") for e in curve if e["fits_one_device"]
+    ):
+        raise RuntimeError(f"parity missing from the curve: {curve}")
+    emit(
+        "serving_sharded_vs_replicated",
+        big["sharded_examples_per_sec"], "examples/sec",
+        extra={
+            "n_devices": n_devices,
+            "mesh": {"data": 1, "model": n_devices},
+            "device_budget_mb": device_budget_mb,
+            "replicated_lanes": replicated_lanes,
+            "depth": depth,
+            "buckets": list(buckets),
+            "requests": n_requests,
+            "crossover_curve": curve,
+            "over_budget_d": big_d,
+            "over_budget_params_mb": big["params_mb"],
+            "over_budget_max_device_params_mb": big[
+                "max_device_params_mb"
+            ],
+            "over_budget_served_sharded": True,
         },
     )
 
@@ -1912,6 +2146,15 @@ def run_featurize_benches(emit) -> None:
     bench_device_featurize(emit)
 
 
+def run_shard_benches(emit) -> None:
+    """The model-axis A/B alone (``--shard-only``, what
+    ``bin/smoke-shard.sh`` invokes; ~60 s of gateway warmups across
+    the size sweep). Its own model shapes — the size sweep and the
+    over-budget model ARE the measurement, so it doesn't inherit the
+    generic bench dims."""
+    bench_sharded_vs_replicated(emit)
+
+
 def run_serving_benches(
     emit,
     d: int = 256,
@@ -1923,6 +2166,7 @@ def run_serving_benches(
     fleet: bool = False,
     autoscale: bool = False,
     featurize: bool = False,
+    shard: bool = False,
 ) -> None:
     fitted = build_pipeline(d, hidden, depth)
     bench_cold_vs_warm(emit, fitted, buckets, d)
@@ -1965,6 +2209,8 @@ def run_serving_benches(
                           buckets=buckets, fitted=fitted)
     if featurize:
         run_featurize_benches(emit)
+    if shard:
+        run_shard_benches(emit)
     if autoscale:
         # its own (smaller) pipeline: scale-up reaction time includes
         # per-replica warmup, which the default bench shape would
@@ -2057,6 +2303,18 @@ def main(argv=None) -> int:
     ap.add_argument("--featurize-only", action="store_true",
                     help="run ONLY the device-side featurization row "
                     "(what bin/smoke-featurize.sh invokes)")
+    ap.add_argument("--shard", action="store_true",
+                    help="also run the model-axis A/B "
+                    "(serving_sharded_vs_replicated): the same model "
+                    "served mesh-sharded (param_sharding over a "
+                    "(1, N)-device mesh) vs N replicated lanes, "
+                    "asserting output parity and that the "
+                    "over-one-device-budget model serves sharded; "
+                    "needs >= 2 devices (on CPU: XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=8)")
+    ap.add_argument("--shard-only", action="store_true",
+                    help="run ONLY the model-axis A/B "
+                    "(what bin/smoke-shard.sh invokes)")
     ap.add_argument("--autoscale", action="store_true",
                     help="also run the elasticity row "
                     "(serving_autoscale_ramp): a step-load ramp "
@@ -2097,7 +2355,9 @@ def main(argv=None) -> int:
         print(json.dumps(row), flush=True)
 
     def run():
-        if args.featurize_only:
+        if args.shard_only:
+            run_shard_benches(emit)
+        elif args.featurize_only:
             run_featurize_benches(emit)
         elif args.autoscale_only:
             run_autoscale_benches(emit)
@@ -2119,6 +2379,7 @@ def main(argv=None) -> int:
                 fleet=args.fleet,
                 autoscale=args.autoscale,
                 featurize=args.featurize,
+                shard=args.shard,
             )
 
     if args.profile_dir:

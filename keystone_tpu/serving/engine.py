@@ -18,6 +18,23 @@ padded batch. The optional sharded variant places each staged batch
 over the mesh data axis for multi-chip serving — same program, one
 compile per bucket, XLA inserts the collectives.
 
+**Mesh-sharded parameters** (``param_sharding=``): the model axis.
+``shard=`` scales the *batch*; a model whose parameters exceed one
+chip's HBM needs the *weights* split. ``param_sharding`` resolves a
+declarative rule set (``serving/sharding.py``: regex over the fitted
+pipeline's named param pytree -> ``PartitionSpec``; ``True`` = the
+default solver-output rules) against the pipeline, places each param
+over the mesh's model axis via ``NamedSharding`` once at construction,
+and traces every bucket program with the params as explicit *arguments*
+(``ParamBinder``) instead of baked-in constants — each device's
+executable holds only its weight shards. Composes with ``shard=``
+(rows over ``data``, weights over ``model``, one 2-D mesh) and with
+``featurize=`` (the fused stage's params stay baked/replicated; pass
+rules matching them to split those too — they ride the same binder
+only for the model pipeline). The AOT fingerprint carries a
+``sharding_token`` so a mesh-sharded program can never collide with a
+replicated one (or with a different partitioning/mesh shape).
+
 **Device-side featurization** (``featurize=``): a second fitted
 pipeline — a pure-JAX featurize chain such as the ``ops/images``
 Convolver/LCS/FisherVector stacks — fused IN FRONT of the model into
@@ -75,6 +92,35 @@ def _round_up(n: int, mult: int) -> int:
     return -(-n // mult) * mult
 
 
+class _ParamBoundFn:
+    """Adapts a two-argument ``(params, batch)`` program — what a
+    model-sharded engine traces — to the engine's one-argument fn
+    convention, binding the placed (sharded, committed) param tree.
+    Every dispatch passes the same placed arrays; only the batch
+    varies. ``lower`` delegates for the cost-model/AOT path, and the
+    wrapped program may be a polymorphic jit fn OR a rigid stored
+    ``jax.stages.Compiled`` (see ``_is_stored_executable``)."""
+
+    def __init__(self, fn, params):
+        self.fn = fn
+        self.params = params
+
+    def __call__(self, staged):
+        return self.fn(self.params, staged)
+
+    def lower(self, staged):
+        return self.fn.lower(self.params, staged)
+
+
+def _is_stored_executable(fn) -> bool:
+    """True when ``fn`` dispatches a shape/dtype-RIGID stored
+    executable (directly, or wrapped with its bound params) — the
+    discriminator for the off-spec TypeError detour in
+    ``compute_staged``."""
+    inner = fn.fn if isinstance(fn, _ParamBoundFn) else fn
+    return isinstance(inner, jax.stages.Compiled)
+
+
 class CompiledPipeline:
     """A ``FittedPipeline`` behind a fixed set of compiled batch shapes.
 
@@ -101,6 +147,19 @@ class CompiledPipeline:
                (array-mode, pure JAX) like ``pipeline`` itself; the
                AOT-store fingerprint covers it (one featurizer's
                cached executable can never serve another's).
+    param_sharding: shard the MODEL over the mesh's model axis
+               (serving/sharding.py): ``True`` resolves the default
+               rule set against the pipeline's named params, a
+               sequence of ``(regex, PartitionSpec)`` rules or a
+               ``{name: spec}`` dict partitions explicitly. Params
+               are placed once (sharded ``NamedSharding``) and become
+               arguments of every bucket program, so each device
+               holds only its shard — models bigger than one chip's
+               HBM serve on the mesh. Buckets round up to the mesh's
+               data-shard count exactly as under ``shard=`` (staged
+               batches are mesh-placed either way).
+               ``param_sharding_unmatched="replicate"`` downgrades
+               unmatched-param errors to replication.
     """
 
     def __init__(
@@ -115,6 +174,8 @@ class CompiledPipeline:
         name: Optional[str] = None,
         aot_store: Any = "auto",
         featurize: Any = None,
+        param_sharding: Any = None,
+        param_sharding_unmatched: str = "error",
     ):
         if not buckets:
             raise ValueError("need at least one bucket")
@@ -124,10 +185,44 @@ class CompiledPipeline:
         self.featurize = featurize
         self.shard = shard
         self.mesh = mesh
-        if shard:
-            m = mesh or mesh_lib.current_mesh()
-            self.mesh = m
-            nshards = mesh_lib.n_data_shards(m)
+        if shard or param_sharding:
+            self.mesh = mesh or mesh_lib.current_mesh()
+        # -- model axis: declarative param sharding over the mesh ------
+        self._binder = None
+        self.param_sharding: Optional[Dict[str, Any]] = None
+        self._placed_params = None
+        if param_sharding:
+            from keystone_tpu.serving import sharding as sharding_lib
+
+            self._binder = sharding_lib.ParamBinder(pipeline)
+            self.param_sharding = sharding_lib.resolve_param_sharding(
+                param_sharding, pipeline,
+                params=self._binder.params,
+                unmatched=param_sharding_unmatched,
+            )
+            shard_fns = sharding_lib.make_shard_fns(
+                self.param_sharding, self.mesh
+            )
+            # placed ONCE: sharded committed arrays, reused as the
+            # param argument of every bucket program's every dispatch
+            self._placed_params = {
+                name: fn(self._binder.params[name])
+                for name, fn in shard_fns.items()
+            }
+        self.model_sharded = self._binder is not None
+        # staged batches are mesh-placed whenever the engine is mesh-
+        # anything: data-sharded batches for shard=, and mesh-committed
+        # (data axis may be size 1) batches for model-sharded programs
+        # so jit sees committed input shardings consistent with the
+        # placed params
+        self._place_batch = self.shard or self.model_sharded
+        if self._place_batch:
+            # every mesh-placed batch splits its leading axis over the
+            # data axis — buckets must divide evenly whether the engine
+            # shards rows, weights, or both (a model-sharded engine on
+            # a mesh with a >1 data axis would otherwise fail every
+            # device_put for the undivisible buckets)
+            nshards = mesh_lib.n_data_shards(self.mesh)
             buckets = [_round_up(b, nshards) for b in buckets]
         self.buckets: Tuple[int, ...] = tuple(sorted(set(int(b) for b in buckets)))
         self.metrics = metrics if metrics is not None else ServingMetrics()
@@ -146,7 +241,12 @@ class CompiledPipeline:
             if devices else (None, None)
         )
         n_devices = 1
-        if self.shard and self.mesh is not None:
+        if (self.shard or self.model_sharded) and self.mesh is not None:
+            # the engine's device set is the MESH, counted exactly once
+            # whether rows, weights, or both are sharded over it — the
+            # MFU denominator (peak x n_devices) must match what the
+            # program actually runs on, and N lanes sharing one mesh
+            # each count the mesh, never lanes x mesh
             n_devices = int(getattr(self.mesh.devices, "size", 1))
         self.metrics.set_device_peaks(
             peak_flops, peak_membw, n_devices=n_devices
@@ -201,6 +301,26 @@ class CompiledPipeline:
             if self.featurize is not None else None
         )
         metrics = self.metrics
+        binder = self._binder
+
+        if binder is not None:
+            # model-sharded: params are explicit program ARGUMENTS —
+            # jit reads their committed NamedShardings (and the staged
+            # batch's mesh placement) and GSPMD partitions the program;
+            # each device's executable holds only its weight shards
+            def staged_sharded(params, arr):
+                metrics.record_trace(bucket)
+                if feat_run is not None:
+                    arr = feat_run(arr)
+                return binder.run(params, arr)
+
+            return _ParamBoundFn(
+                jax.jit(
+                    staged_sharded,
+                    donate_argnums=(1,) if self.donate else (),
+                ),
+                self._placed_params,
+            )
 
         def staged(arr):
             # executes at TRACE time only — one increment per XLA
@@ -268,7 +388,7 @@ class CompiledPipeline:
             return a
 
         staged = jax.tree_util.tree_map(pad_leaf, tree)
-        if self.shard:
+        if self._place_batch:
             staged = jax.tree_util.tree_map(
                 lambda a: jax.device_put(
                     a, mesh_lib.data_sharding(self.mesh, ndim=a.ndim)
@@ -302,7 +422,7 @@ class CompiledPipeline:
         buffers back block on the returned arrays). The device buffers
         are engine-private (the transfer copies), so downstream compute
         may donate them."""
-        if self.shard:
+        if self._place_batch:
             return jax.tree_util.tree_map(
                 lambda a: jax.device_put(
                     a, mesh_lib.data_sharding(self.mesh, ndim=a.ndim)
@@ -355,7 +475,7 @@ class CompiledPipeline:
             # traces per-aval just like a cold engine's would. A
             # TypeError from a plain jit fn means the REQUEST itself
             # is malformed — that propagates unchanged.
-            if not isinstance(fn, jax.stages.Compiled):
+            if not _is_stored_executable(fn):
                 raise
             report = self._aot.setdefault(bucket, {})
             if not report.get("off_spec"):
@@ -479,7 +599,7 @@ class CompiledPipeline:
                 f"unknown bucket(s) {unknown} (have {self.buckets})"
             )
         store = self._resolve_aot_store()
-        token = feat_token = identity = None
+        token = feat_token = shard_token = identity = None
         if store is not None:
             from keystone_tpu.serving import aot as aot_lib
 
@@ -493,6 +613,18 @@ class CompiledPipeline:
                 token = aot_lib.pipeline_token(self.pipeline)
                 if self.featurize is not None:
                     feat_token = aot_lib.pipeline_token(self.featurize)
+                if self.model_sharded:
+                    # the partitioning + mesh topology are part of the
+                    # program: a mesh-sharded executable must never
+                    # share an entry with a replicated one, nor with a
+                    # different spec tree or mesh shape
+                    from keystone_tpu.serving import (
+                        sharding as sharding_lib,
+                    )
+
+                    shard_token = sharding_lib.sharding_token(
+                        self.param_sharding, self.mesh
+                    )
                 identity = aot_lib.runtime_identity()
             except Exception:
                 # a pipeline whose operators can't be fingerprinted
@@ -516,6 +648,7 @@ class CompiledPipeline:
                     donate=self.donate, shard=self.shard,
                     model_token=token, identity=identity,
                     featurize_token=feat_token,
+                    sharding_token=shard_token,
                 )
                 # the zero-cold-start path: install the serialized
                 # executable BEFORE any trace of this bucket can
@@ -575,6 +708,12 @@ class CompiledPipeline:
             # the report must tell the same story the store counters do
             self._aot[bucket] = {"status": outcome}
             return None
+        if self.model_sharded:
+            # a model-sharded bucket program was serialized as the
+            # two-argument (params, batch) executable; re-bind this
+            # engine's placed params so it dispatches under the
+            # engine's one-argument convention
+            loaded = _ParamBoundFn(loaded, self._placed_params)
         try:
             # validate BEFORE publishing into _fns: warmup is callable
             # on an engine already taking traffic, and a concurrent
@@ -595,7 +734,10 @@ class CompiledPipeline:
             return None
         with self._fn_lock:
             self._fns[bucket] = loaded
-        self._register_cost_model_from(bucket, loaded)
+        self._register_cost_model_from(
+            bucket,
+            loaded.fn if isinstance(loaded, _ParamBoundFn) else loaded,
+        )
         secs = time.perf_counter() - t0
         # only a VALIDATED install counts as a hit, and the histogram
         # gets the full deserialize+validate+install wall

@@ -1,0 +1,96 @@
+"""parallel/mesh.py helpers — until now exercised only indirectly
+through the engines. The conftest provisions 8 virtual CPU devices, so
+1-device, 1-D, 2-D, and dcn-prefixed meshes are all constructible."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec
+
+from keystone_tpu.parallel import mesh as mesh_lib
+
+
+@pytest.fixture
+def devices():
+    return jax.devices()
+
+
+def test_make_mesh_shapes(devices):
+    m = mesh_lib.make_mesh()
+    assert m.shape[mesh_lib.DATA_AXIS] == len(devices)
+    assert m.shape[mesh_lib.MODEL_AXIS] == 1
+
+    m2 = mesh_lib.make_mesh(n_model=4)
+    assert m2.shape[mesh_lib.DATA_AXIS] == len(devices) // 4
+    assert m2.shape[mesh_lib.MODEL_AXIS] == 4
+
+    m3 = mesh_lib.make_mesh(n_data=1, n_model=1, devices=devices[:1])
+    assert m3.devices.size == 1
+
+
+def test_make_mesh_rejects_mismatched_factorization(devices):
+    with pytest.raises(ValueError, match="devices"):
+        mesh_lib.make_mesh(n_data=3, n_model=2, devices=devices[:8])
+
+
+@pytest.mark.needs_mesh8
+def test_data_sharding_on_2d_mesh(devices):
+    m = mesh_lib.make_mesh(n_data=2, n_model=4)
+    s = mesh_lib.data_sharding(m, ndim=3)
+    assert s.mesh is m
+    # leading (example) axis over data, the rest replicated — model
+    # axis untouched, which is what lets batch sharding compose with
+    # param sharding on the same mesh
+    assert s.spec == PartitionSpec(mesh_lib.DATA_AXIS, None, None)
+    assert mesh_lib.n_data_shards(m) == 2
+
+
+def test_data_sharding_on_1_device_mesh(devices):
+    m = mesh_lib.make_mesh(n_data=1, n_model=1, devices=devices[:1])
+    assert mesh_lib.n_data_shards(m) == 1
+    s = mesh_lib.data_sharding(m, ndim=2)
+    assert s.spec == PartitionSpec(mesh_lib.DATA_AXIS, None)
+    # placement through a 1-device sharding is a plain put
+    arr = jax.device_put(np.ones((4, 2), np.float32), s)
+    assert np.asarray(arr).sum() == 8.0
+
+
+def test_replicated_sharding_spec(devices):
+    m = mesh_lib.make_mesh(n_model=2)
+    s = mesh_lib.replicated_sharding(m)
+    assert s.spec == PartitionSpec()
+    assert s.mesh is m
+
+
+@pytest.mark.needs_mesh8
+def test_dcn_axis_spans_data_shards(devices):
+    arr = np.array(devices[:8]).reshape(2, 2, 2)
+    m = Mesh(arr, ("dcn", mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS))
+    # DP spans slices: examples shard over (dcn, data) = 4 ways
+    assert mesh_lib.n_data_shards(m) == 4
+    s = mesh_lib.data_sharding(m, ndim=2)
+    assert s.spec == PartitionSpec(("dcn", mesh_lib.DATA_AXIS), None)
+
+
+def test_use_mesh_nesting_restores(devices):
+    outer = mesh_lib.make_mesh(n_model=1)
+    inner = mesh_lib.make_mesh(n_model=2)
+    with mesh_lib.use_mesh(outer):
+        assert mesh_lib.current_mesh() is outer
+        with mesh_lib.use_mesh(inner):
+            assert mesh_lib.current_mesh() is inner
+        assert mesh_lib.current_mesh() is outer
+    # the conftest reset leaves no mesh pinned; the default is built
+    # lazily over all devices
+    mesh_lib.set_mesh(None)
+    assert mesh_lib.current_mesh().devices.size == len(devices)
+
+
+def test_use_mesh_restores_on_exception(devices):
+    pinned = mesh_lib.make_mesh(n_model=1)
+    mesh_lib.set_mesh(pinned)
+    inner = mesh_lib.make_mesh(n_model=2)
+    with pytest.raises(RuntimeError, match="boom"):
+        with mesh_lib.use_mesh(inner):
+            raise RuntimeError("boom")
+    assert mesh_lib.current_mesh() is pinned

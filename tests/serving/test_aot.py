@@ -512,3 +512,157 @@ def test_build_main_populates_then_hits(tmp_path, monkeypatch, capsys):
     assert aot.build_main(argv) == 0
     report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert {v["status"] for v in report["aot"].values()} == {"hit"}
+
+
+# -- mesh-sharded program isolation (serving/sharding.py) ------------------
+
+def test_bucket_key_sharding_token_isolates():
+    """A mesh-sharded bucket program (GSPMD-partitioned, params as
+    arguments) must never share an entry with the replicated program
+    of the same model, nor with a different partitioning — while
+    replicated fingerprints stay byte-identical to pre-sharding
+    stores (no fleet-wide cold start on upgrade)."""
+    specs = [((D,), np.float32)]
+    args = dict(specs=specs, buckets=(4,), bucket=4, donate=False,
+                shard=False, model_token="m")
+    plain, plain_meta = aot.bucket_key(**args)
+    shd1, meta1 = aot.bucket_key(**args, sharding_token="s1")
+    shd2, meta2 = aot.bucket_key(**args, sharding_token="s2")
+    assert len({plain, shd1, shd2}) == 3
+    # replicated meta carries NO sharding key: existing entries keep
+    # their fingerprints across the upgrade
+    assert "sharding_token" not in plain_meta
+    assert (meta1["sharding_token"], meta2["sharding_token"]) == (
+        "s1", "s2"
+    )
+    # explicit None is the replicated fingerprint, byte for byte
+    none_key, none_meta = aot.bucket_key(**args, sharding_token=None)
+    assert none_key == plain and none_meta == plain_meta
+    # and the two token kinds can't stand in for each other
+    feat, _ = aot.bucket_key(**args, featurize_token="s1")
+    assert feat != shd1
+
+
+@pytest.fixture
+def model_mesh():
+    from keystone_tpu.parallel import mesh as mesh_lib
+
+    m = mesh_lib.make_mesh(n_data=1, n_model=8)
+    with mesh_lib.use_mesh(m):
+        yield m
+
+
+def _sharded_engine(fitted, store, name, mesh):
+    eng = fitted.compiled(
+        buckets=(4,), name=name, aot_store=store,
+        param_sharding=True, mesh=mesh,
+    )
+    eng.warmup(example=EXAMPLE)
+    return eng
+
+
+@pytest.mark.needs_mesh8
+def test_sharded_roundtrip_and_replicated_never_collide(
+    tmp_path, fitted, model_mesh
+):
+    """End to end: a sharded engine's entry hits for the SAME
+    partitioning (zero compiles, identical outputs); the replicated
+    engine for the same model gets its own distinct entry, never the
+    sharded executable."""
+    store = make_store(tmp_path)
+    x = np.random.default_rng(2).standard_normal((3, D)).astype(
+        np.float32
+    )
+
+    e1 = _sharded_engine(fitted, store, "aot-shd-1", model_mesh)
+    assert statuses(e1) == {4: "saved"}
+    out1 = np.asarray(e1.apply(x, sync=True))
+
+    e2 = _sharded_engine(fitted, store, "aot-shd-2", model_mesh)
+    assert statuses(e2) == {4: "hit"}
+    assert e2.metrics.compile_count == 0
+    np.testing.assert_array_equal(
+        np.asarray(e2.apply(x, sync=True)), out1
+    )
+
+    # replicated engine, same model + specs: MISS, own entry
+    entries_before = set(store.entries())
+    plain = fitted.compiled(buckets=(4,), aot_store=store,
+                            name="aot-shd-p")
+    plain.warmup(example=EXAMPLE)
+    assert statuses(plain) == {4: "saved"}
+    assert set(store.entries()) > entries_before
+    np.testing.assert_allclose(
+        np.asarray(plain.apply(x, sync=True)), out1,
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+@pytest.mark.needs_mesh8
+def test_sharded_cross_plant_rejected_at_meta_recheck(
+    tmp_path, fitted, model_mesh
+):
+    """A sharded entry's bytes planted at the replicated key (and vice
+    versa) are rejected on the stored-meta re-check BEFORE anything is
+    unpickled: counted error, recompile, never a wrong program."""
+    import shutil
+
+    from keystone_tpu.parallel import mesh as mesh_lib
+    from keystone_tpu.serving import sharding as sharding_lib
+    from keystone_tpu.serving.aot import pipeline_token, runtime_identity
+
+    store = make_store(tmp_path)
+    e1 = _sharded_engine(fitted, store, "aot-xp-1", model_mesh)
+    assert statuses(e1) == {4: "saved"}
+
+    specs = [((D,), np.dtype(np.float32))]
+    ident = runtime_identity()
+    token = pipeline_token(fitted)
+    shd_key, _ = aot.bucket_key(
+        specs, (4,), 4, donate=e1.donate, shard=False,
+        model_token=token, identity=ident,
+        sharding_token=sharding_lib.sharding_token(
+            e1.param_sharding, model_mesh
+        ),
+    )
+    plain_key, _ = aot.bucket_key(
+        specs, (4,), 4, donate=e1.donate, shard=False,
+        model_token=token, identity=ident,
+    )
+    assert shd_key in store.entries()
+    # plant the sharded entry at the replicated fingerprint
+    shutil.copyfile(store.path_for(shd_key), store.path_for(plain_key))
+    errors_before = store.errors
+
+    plain = fitted.compiled(buckets=(4,), aot_store=store,
+                            name="aot-xp-p")
+    plain.warmup(example=EXAMPLE)
+    assert statuses(plain)[4] == "error"
+    assert store.errors > errors_before
+    assert plain.metrics.compile_count == 1  # counted recompile
+    x = np.random.default_rng(3).standard_normal((2, D)).astype(
+        np.float32
+    )
+    np.testing.assert_allclose(
+        np.asarray(plain.apply(x, sync=True)),
+        np.asarray(e1.apply(x, sync=True)),
+        rtol=1e-5, atol=1e-6,
+    )
+
+    # the reverse plant: replicated bytes at a DIFFERENT mesh's key
+    m24 = mesh_lib.make_mesh(n_data=2, n_model=4)
+    with mesh_lib.use_mesh(m24):
+        other_key, _ = aot.bucket_key(
+            specs, (4,), 4, donate=e1.donate, shard=False,
+            model_token=token, identity=ident,
+            sharding_token=sharding_lib.sharding_token(
+                sharding_lib.resolve_param_sharding(True, fitted), m24
+            ),
+        )
+        assert other_key not in store.entries()
+        shutil.copyfile(
+            store.path_for(shd_key), store.path_for(other_key)
+        )
+        e24 = _sharded_engine(fitted, store, "aot-xp-24", m24)
+    assert statuses(e24)[4] == "error"
+    assert e24.metrics.compile_count == 1
