@@ -1,19 +1,25 @@
 #!/usr/bin/env bash
 # Smoke-test device-side featurization end to end:
 #
-#  1. the `serving_device_featurize` bench row — the same image
-#     featurize chain + model served through a host_featurize gateway
-#     vs a device_featurize gateway, with the row's own asserts
-#     (outputs allclose, device-path H2D bytes/request <= 1/3 of the
-#     host path, device examples/sec >= host) re-checked here off the
-#     emitted JSON;
-#  2. a real `serve-gateway --device-featurize` subprocess: POST a raw
-#     uint8 image to /predict, assert predictions come back and that
-#     `keystone_serving_h2d_bytes_total` is on /metrics with the raw
-#     byte footprint (bucket * img * img * 3) — the wire-bytes win as
-#     a scraped fact.
+#  1. the `serving_device_featurize` and `serving_flagship_featurize`
+#     bench rows — the demo conv chain and the flagship SIFT+LCS->FV
+#     chain, each served through a host_featurize gateway vs a
+#     device_featurize gateway, with the rows' own asserts (outputs
+#     allclose, device-path H2D bytes/request <= 1/3 of the host path,
+#     device examples/sec >= host, and — flagship — the fused
+#     program's cost-model/MFU/roofline series present) re-checked
+#     here off the emitted JSON. KEYSTONE_PEAK_* exports give the CPU
+#     backend known "hardware" peaks so the MFU/roofline series are
+#     concretely present, not skipped-as-unknown;
+#  2. a real `serve-gateway --device-featurize` subprocess (demo
+#     chain): POST a raw uint8 image to /predict, assert predictions
+#     come back and that `keystone_serving_h2d_bytes_total` is on
+#     /metrics with the raw byte footprint (bucket * img * img * 3) —
+#     the wire-bytes win as a scraped fact;
+#  3. the same drill against `--device-featurize flagship` — the
+#     branched Pallas-kernel chain behind the same gateway seam.
 #
-# CI-friendly: CPU backend, ~60s, no network beyond localhost.
+# CI-friendly: CPU backend, ~2-3 min, no network beyond localhost.
 #
 #   bin/smoke-featurize.sh
 set -euo pipefail
@@ -28,8 +34,12 @@ cleanup() {
 }
 trap cleanup EXIT
 
-echo "== serving_device_featurize bench row =="
+echo "== serving_device_featurize + serving_flagship_featurize bench rows =="
+# CPU has no PEAK_TABLE entry; the env overrides give the backend
+# known peaks so the flagship row's MFU/roofline series must be
+# PRESENT (the row raises on absence when peaks are known)
 JAX_PLATFORMS=cpu PYTHONPATH="$ROOT" \
+    KEYSTONE_PEAK_FLOPS=1e12 KEYSTONE_PEAK_MEMBW_GBPS=100 \
     python -m keystone_tpu serve-bench --featurize-only \
     | tee "$BENCH_OUT"
 
@@ -47,8 +57,23 @@ print(
     f"{row['h2d_reduction']}x fewer H2D bytes/request, "
     f"bottleneck {row['host_bottleneck']} -> {row['device_bottleneck']}"
 )
+fl = next(r for r in rows if r.get("metric") == "serving_flagship_featurize")
+assert fl["outputs_allclose"] is True, fl
+assert fl["h2d_reduction"] >= 3.0, fl
+assert fl["device_examples_per_sec"] >= fl["host_examples_per_sec"], fl
+assert fl["fv_kernel"] == "pallas_fused", fl
+assert fl["cost_model_buckets"], fl
+assert fl["peaks_known"] is True, fl
+assert fl["mfu"] is not None, fl
+assert all(v in ("compute", "bandwidth") for v in fl["roofline"].values()), fl
+print(
+    f"flagship row OK: {fl['device_examples_per_sec']} ex/s fused vs "
+    f"{fl['host_examples_per_sec']} host, "
+    f"{fl['h2d_reduction']}x fewer H2D bytes/bucket-row, "
+    f"mfu={fl['mfu']}, roofline={fl['roofline']}"
+)
 PY
-echo "PASS bench row"
+echo "PASS bench rows"
 
 echo "== serve-gateway --device-featurize drill =="
 IMG=8
@@ -128,6 +153,73 @@ grep -qF "keystone_serving_h2d_bytes_total{engine=\"gateway-lane0\",bucket=\"4\"
     grep keystone_serving_h2d <<<"$METRICS" || true
     exit 1; }
 echo "PASS /metrics keystone_serving_h2d_bytes_total ($WANT_BYTES raw bytes)"
+
+kill "$SERVER_PID" 2>/dev/null || true
+wait "$SERVER_PID" 2>/dev/null || true
+SERVER_PID=""
+
+echo "== serve-gateway --device-featurize flagship drill =="
+# img must clear the LCS border (> 32); 34 keeps the CPU warmup quick
+FIMG=34
+JAX_PLATFORMS=cpu PYTHONPATH="$ROOT" \
+    python -m keystone_tpu serve-gateway --gateway-port 0 \
+    --device-featurize flagship --img "$FIMG" --buckets 4,8 --lanes 1 \
+    --hidden 64 --depth 2 >"$SERVER_LOG.flagship" 2>&1 &
+SERVER_PID=$!
+
+BASE=""
+for _ in $(seq 1 240); do
+    BASE="$(python - "$SERVER_LOG.flagship" <<'PY'
+import json, sys
+try:
+    for line in open(sys.argv[1]):
+        line = line.strip()
+        if line.startswith("{"):
+            print(json.loads(line)["listening"]); break
+except Exception:
+    pass
+PY
+)"
+    [[ -n "$BASE" ]] && break
+    kill -0 "$SERVER_PID" 2>/dev/null || {
+        echo "FAIL: flagship gateway died before binding"
+        cat "$SERVER_LOG.flagship"; exit 1; }
+    sleep 0.5
+done
+[[ -n "$BASE" ]] || {
+    echo "FAIL: no flagship handshake after 120s"
+    cat "$SERVER_LOG.flagship"; exit 1; }
+echo "flagship gateway up on $BASE"
+
+PRED="$(python - "$BASE" "$FIMG" <<'PY'
+import json, sys, urllib.request
+base, img = sys.argv[1], int(sys.argv[2])
+inst = [[[x % 251, y % 251, (x + y) % 251] for y in range(img)]
+        for x in range(img)]
+req = urllib.request.Request(
+    base + "/predict",
+    data=json.dumps({"instances": [inst]}).encode(),
+    headers={"Content-Type": "application/json"},
+)
+print(urllib.request.urlopen(req, timeout=120).read().decode())
+PY
+)"
+grep -q '"predictions"' <<<"$PRED" || {
+    echo "FAIL: flagship /predict returned: $PRED"
+    cat "$SERVER_LOG.flagship"; exit 1; }
+echo "PASS flagship /predict (raw uint8 image through the SIFT+LCS->FV DAG)"
+
+METRICS="$(python -c 'import sys, urllib.request; \
+sys.stdout.write(urllib.request.urlopen(sys.argv[1], timeout=15).read().decode())' \
+    "$BASE/metrics")"
+# single instance -> bucket 4: 4 * FIMG*FIMG*3 raw uint8 bytes staged
+WANT_BYTES=$((4 * FIMG * FIMG * 3))
+grep -qF "keystone_serving_h2d_bytes_total{engine=\"gateway-lane0\",bucket=\"4\"} $WANT_BYTES" \
+    <<<"$METRICS" || {
+    echo "FAIL: flagship /metrics missing the h2d bytes counter ($WANT_BYTES expected):"
+    grep keystone_serving_h2d <<<"$METRICS" || true
+    exit 1; }
+echo "PASS flagship /metrics keystone_serving_h2d_bytes_total ($WANT_BYTES raw bytes)"
 
 kill "$SERVER_PID" 2>/dev/null || true
 wait "$SERVER_PID" 2>/dev/null || true

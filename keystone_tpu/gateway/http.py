@@ -701,20 +701,26 @@ def main(argv=None) -> int:
     ap.add_argument("--d", type=int, default=256)
     ap.add_argument("--hidden", type=int, default=512)
     ap.add_argument("--depth", type=int, default=4)
-    ap.add_argument("--device-featurize", action="store_true",
+    ap.add_argument("--device-featurize", nargs="?", const="demo",
+                    choices=("demo", "flagship"), default=None,
+                    metavar="CHAIN",
                     help="serve RAW uint8 images instead of f32 "
                     "feature vectors: a pure-JAX image featurize "
-                    "chain (serving/featurize.build_featurize_pipeline) "
-                    "is fused in front of the model inside every "
-                    "bucket program, so /predict instances are "
-                    "(--img, --img, 3) uint8 arrays, the wire/staging "
-                    "path carries ~4x fewer bytes, and cast + "
-                    "featurize + predict ride one compiled dispatch "
-                    "(--d is derived from the featurize output and "
-                    "ignored)")
-    ap.add_argument("--img", type=int, default=16,
+                    "chain (serving/featurize.py) is fused in front "
+                    "of the model inside every bucket program, so "
+                    "/predict instances are (--img, --img, 3) uint8 "
+                    "arrays, the wire/staging path carries fewer "
+                    "bytes, and cast + featurize + predict ride one "
+                    "compiled dispatch (--d is derived from the "
+                    "featurize output and ignored). CHAIN picks the "
+                    "chain: 'demo' (bare flag; the dense-conv stack, "
+                    "default --img 16) or 'flagship' (the branched "
+                    "SIFT+LCS -> PCA -> GMM Fisher Vector DAG with "
+                    "Pallas hot loops, default --img 64)")
+    ap.add_argument("--img", type=int, default=None,
                     help="raw image edge length under "
-                    "--device-featurize")
+                    "--device-featurize (default: 16 for the demo "
+                    "chain, 64 for flagship)")
     ap.add_argument("--shard-model", action="store_true",
                     help="mesh-shard the MODEL over the local devices "
                     "(serving/sharding.py): the process mesh is pinned "
@@ -761,9 +767,17 @@ def main(argv=None) -> int:
     if args.device_featurize:
         from keystone_tpu.serving.featurize import (
             build_featurize_pipeline,
+            build_flagship_featurize_pipeline,
         )
 
-        featurize, feat_d = build_featurize_pipeline(img=args.img)
+        if args.device_featurize == "flagship":
+            args.img = args.img if args.img is not None else 64
+            featurize, feat_d = build_flagship_featurize_pipeline(
+                img=args.img
+            )
+        else:
+            args.img = args.img if args.img is not None else 16
+            featurize, feat_d = build_featurize_pipeline(img=args.img)
         args.d = feat_d  # the model consumes the featurize output
         warmup_example = jnp.zeros((args.img, args.img, 3), jnp.uint8)
         input_dtype = np.uint8

@@ -41,7 +41,16 @@ import os
 import tarfile
 from collections import deque
 from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
-from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 import numpy as np
 
@@ -302,6 +311,28 @@ class StreamingImageLoader:
                 fill = 0
         if fill:
             yield buf, labels, fill
+
+    def featurized_batches(
+        self, engine, batch_size: int
+    ) -> Iterator[Tuple[Any, List[object], int]]:
+        """(features (B, F) device array, labels, n_valid) batches:
+        the decode stream feeds RAW uint8 into a fused serving engine
+        (``CompiledPipeline`` — typically a frozen featurize chain
+        ``compiled()``, or a model engine with ``featurize=``), so the
+        H2D wire carries pixels, not f32 features, and cast + featurize
+        run inside the engine's per-bucket XLA program. This is the
+        TRAINING loaders' route onto the same fused featurize
+        implementation the serving gateway runs — one chain, one set of
+        compiled programs, one ``h2d_bytes`` accounting, fit and serve.
+
+        Dispatch is async (the engine enqueues; decode of batch k+1
+        overlaps device compute of batch k). The final short batch is
+        served zero-padded at ``batch_size`` rows — the engine pads to
+        a bucket anyway, and a constant batch shape keeps the compile
+        count at one program; slice features to ``n_valid``. Callers
+        own the sync point (materialize the yielded arrays)."""
+        for buf, labels, n_valid in self.batches(batch_size, np.uint8):
+            yield engine.apply(buf), labels, n_valid
 
 
 def StreamingImageNetLoader(

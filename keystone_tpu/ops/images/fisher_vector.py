@@ -100,11 +100,10 @@ class FisherVectorFused(Transformer):
             fisher_vector_stats_pallas,
         )
 
-        interpret = jax.default_backend() != "tpu"
         g = self.gmm
         s0, s1, s2 = fisher_vector_stats_pallas(
             jnp.asarray(x, jnp.float32), g.means, g.variances, g.weights,
-            g.weight_threshold, interpret=interpret,
+            g.weight_threshold,
         )
         return _fv_from_stats(g, s0, s1, s2)
 

@@ -20,12 +20,15 @@ choice) see fisher_vector.FisherVector.
 from __future__ import annotations
 
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from keystone_tpu.ops.images.pallas_kernels import auto_interpret
 
 TILE_M = 512  # descriptors per grid step; X chunk is TILE_M x d in VMEM
 
@@ -76,11 +79,15 @@ def _fv_stats_kernel(
 @partial(jax.jit, static_argnames=("interpret",))
 def fisher_vector_stats_pallas(
     x, means, variances, weights, weight_threshold=1e-4,
-    *, interpret: bool = False
+    *, interpret: Optional[bool] = None
 ):
     """x: (d, m) descriptors -> (s0 (k,), s1 (d, k), s2 (d, k)), each
     already divided by m (the FisherVector.scala:33-41 statistics, with
-    the GMM's posterior thresholding applied)."""
+    the GMM's posterior thresholding applied). ``interpret=None``
+    auto-selects the backend: Mosaic-compiled on TPU, the Pallas
+    interpreter elsewhere (``pallas_kernels.auto_interpret``) — callers
+    no longer carry their own backend check."""
+    interpret = auto_interpret(interpret)
     d, m = x.shape
     k = means.shape[1]
     inv_var = 1.0 / variances  # (d, k)

@@ -11,7 +11,10 @@ neighborhood positions are affine in (key, neighbor) — so box-mean →
 sample folds into one per-axis SAMPLING MATRIX applied as MXU GEMMs
 (same reformulation as SIFT's spatial binning, sift.py
 ``_sampling_matrix``), once on the image for means and once on its
-square for the variances. No convs, no gathers.
+square for the variances. No convs, no gathers. The GEMM pair runs as
+the ``pallas_kernels.plane_sandwich`` kernel — each channel plane
+(image and image² stacked) stays VMEM-resident between its two dots,
+with interpret-mode fallback keeping CPU CI on the same dataflow.
 """
 
 from __future__ import annotations
@@ -23,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from keystone_tpu.ops.images.pallas_kernels import plane_sandwich
 from keystone_tpu.parallel.dataset import Dataset
 from keystone_tpu.workflow.api import Transformer
 
@@ -98,17 +102,17 @@ class LCSExtractor(Transformer):
         end = s + s // 2 - 1
         offs = np.arange(start, end + 1, s)
 
-        Ax = jnp.asarray(_lcs_sampling_matrix(X, xs, offs, s))
+        Ax = _lcs_sampling_matrix(X, xs, offs, s)
         Ay = jnp.asarray(_lcs_sampling_matrix(Y, ys, offs, s))
-        hp = jax.lax.Precision.HIGHEST  # validated at 1e-4 vs the naive
-        # translation; TPU DEFAULT lands at ~1e-3
-
-        def box_sample(z):  # (X, Y, C') -> (nxk·nb, nyk·nb, C')
-            t1 = jnp.einsum("xyc,xm->myc", z, Ax, precision=hp)
-            return jnp.einsum("myc,yn->mnc", t1, Ay, precision=hp)
-
-        # image and its square share the GEMM chain (stacked channels)
-        both = box_sample(jnp.concatenate([img, img * img], axis=-1))
+        # image and its square share the GEMM chain (stacked channel
+        # planes through the Pallas sandwich kernel; HIGHEST-precision
+        # dots in-kernel — validated at 1e-4 vs the naive translation,
+        # TPU DEFAULT lands at ~1e-3)
+        z = jnp.concatenate([img, img * img], axis=-1)
+        out = plane_sandwich(
+            jnp.transpose(z, (2, 0, 1)), jnp.asarray(Ax.T.copy()), Ay
+        )
+        both = jnp.transpose(out, (1, 2, 0))  # (nxk·nb, nyk·nb, 2C)
         m, sq = both[..., :C], both[..., C:]
         sd = jnp.sqrt(jnp.maximum(sq - m * m, 0.0))
 

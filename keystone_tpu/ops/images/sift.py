@@ -24,9 +24,8 @@ in this environment, so bit-level parity against vlfeat cannot be
 asserted here; the algorithm is validated against an independent numpy
 translation of the same spec (tests/ops/test_sift_fv.py).
 
-TPU mapping: everything is fused XLA — gradients, one-hot orientation
-scatter, and the whole spatial-binning stage (triangular convolution +
-bin-center sampling + Gaussian window factors) folded into two small
+TPU mapping: the whole spatial-binning stage (triangular convolution +
+bin-center sampling + Gaussian window factors) folds into two small
 per-scale SAMPLING MATRICES applied as MXU GEMMs. The stage is linear
 in the orientation planes and separable per axis, so
 ``A[y, f·4+j] = tri(y − (bound + f·step + j·bin)) · wf[j]`` expresses
@@ -35,7 +34,11 @@ conv→strided-slice formulation on the v5e (SIFT device time ~110 →
 ~22 ms per 128×256² batch; the C=1 depthwise convs ran on the VPU and
 the slicing materialized awkwardly-tiled intermediates), lifting the
 flagship featurize row from 889 to 1806 ex/s/chip (PERF_r05.md).
-Static shapes per (W, H, scale).
+The binning+GEMM hot loop itself runs as the ``pallas_kernels.
+sift_bin_sample`` kernel: the trilinear orientation scatter and both
+sampling-matrix contractions fuse in VMEM, so the (8, H, W) plane
+stack never hits HBM (interpret-mode fallback keeps CPU CI on the
+same dataflow). Static shapes per (W, H, scale).
 """
 
 from __future__ import annotations
@@ -48,6 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from keystone_tpu.ops.images.pallas_kernels import sift_bin_sample
 from keystone_tpu.parallel.dataset import Dataset
 from keystone_tpu.workflow.api import Transformer
 
@@ -144,14 +148,6 @@ def _dsift_one_scale(img, *, bin_size: int, step: int, bound_min: int):
     mag = jnp.sqrt(gx * gx + gy * gy)
     ang = jnp.arctan2(gy, gx) % (2.0 * jnp.pi)
     t = ang / (2.0 * jnp.pi) * NUM_ORIENTATIONS
-    b0 = jnp.floor(t)
-    frac = t - b0
-    b0 = b0.astype(jnp.int32) % NUM_ORIENTATIONS
-    b1 = (b0 + 1) % NUM_ORIENTATIONS
-    planes = (
-        jax.nn.one_hot(b0, NUM_ORIENTATIONS, axis=0) * (mag * (1 - frac))
-        + jax.nn.one_hot(b1, NUM_ORIENTATIONS, axis=0) * (mag * frac)
-    )  # (8, H, W)
 
     extent = (NUM_SPATIAL_BINS - 1) * bin_size
     nfy = max((H - 1 - bound_min - extent) // step + 1, 0)
@@ -162,12 +158,12 @@ def _dsift_one_scale(img, *, bin_size: int, step: int, bound_min: int):
             jnp.zeros((0,), jnp.float32),
         )
     # the whole tri-conv → bin-sample → window stage as two GEMMs (see
-    # _sampling_matrix); f32 HIGHEST keeps full conv accuracy
-    Ay = jnp.asarray(_sampling_matrix(H, nfy, bin_size, step, bound_min))
+    # _sampling_matrix), fused with the trilinear orientation binning
+    # in one Pallas kernel — each orientation plane is built and
+    # contracted in VMEM, never written to HBM
+    Ay = _sampling_matrix(H, nfy, bin_size, step, bound_min)
     Ax = jnp.asarray(_sampling_matrix(W, nfx, bin_size, step, bound_min))
-    hp = jax.lax.Precision.HIGHEST
-    t1 = jnp.einsum("thw,hm->tmw", planes, Ay, precision=hp)
-    g = jnp.einsum("tmw,wn->tmn", t1, Ax, precision=hp)
+    g = sift_bin_sample(mag, t, jnp.asarray(Ay.T.copy()), Ax)
     g = g.reshape(
         NUM_ORIENTATIONS, nfy, NUM_SPATIAL_BINS, nfx, NUM_SPATIAL_BINS
     )

@@ -1,17 +1,32 @@
-"""Reference device-side featurize chain for serving.
+"""Reference device-side featurize chains for serving.
 
 ``CompiledPipeline(featurize=...)`` fuses any fitted pure-JAX pipeline
-in front of the model; this module provides the canonical image chain
-the ``--device-featurize`` gateway mode, the ``serving_device_featurize``
-bench row, and the smoke/tests all share — kept OUT of the benchmark
-module so the production CLI path doesn't depend on bench code. Real
-deployments build their own featurize ``FittedPipeline`` from the
-``ops/images`` nodes (Convolver, LCS, FisherVector, ...) the same way.
+in front of the model; this module provides the canonical image chains
+the ``--device-featurize`` gateway modes, the featurize bench rows, and
+the smoke/tests all share — kept OUT of the benchmark module so the
+production CLI path doesn't depend on bench code.
+
+Two chains:
+
+- ``build_featurize_pipeline`` — the *demo* dense-conv stack
+  (PixelScaler → Convolver → rectify → pool → vectorize), the cheap
+  geometry the PR-14 plumbing was proven on;
+- ``build_flagship_featurize_pipeline`` — the paper's flagship
+  ImageNetSiftLcsFV featurization: a **branched** DAG (gray→SIFT and
+  LCS branches, each PCA → GMM Fisher Vector → Hellinger/L2
+  normalization, gathered through ``VectorCombiner``) whose hot loops
+  run as Pallas kernels (``ops/images/pallas_kernels``, ``fv_pallas``).
+  Fittable-then-frozen: pass ``fit_images`` to fit real PCA/GMM
+  parameters through the reference estimator path, or let the seeded
+  warm-start stand in where a deterministic chain is what matters
+  (gateway startup, benches, tests). Either way the result is a frozen
+  pure-JAX ``FittedPipeline`` that ``CompiledPipeline(featurize=)``
+  fuses — branches and all — into each per-bucket XLA program.
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Any, Optional, Tuple
 
 import numpy as np
 
@@ -70,4 +85,192 @@ def build_featurize_pipeline(
     return fitted, feat_dim
 
 
-__all__ = ["build_featurize_pipeline"]
+def flagship_pipeline(
+    rng: np.random.Generator,
+    desc_dim: int = 64,
+    vocab: int = 16,
+    *,
+    sift_step: int = 3,
+    sift_bin: int = 4,
+    sift_scales: int = 4,
+    sift_scale_step: int = 1,
+    lcs_stride: int = 4,
+    lcs_border: int = 16,
+    lcs_patch: int = 6,
+):
+    """The unfitted warm-start ImageNetSiftLcsFV featurize chain —
+    everything in ``pipelines/images/imagenet_sift_lcs_fv.build_pipeline``
+    before the solver, with seeded random PCA projections and unit
+    GMMs standing in for the fitted parameters (the shape/dataflow is
+    identical; only the learned values differ). The FV node follows the
+    reference's k >= 32 physical choice: the fused Pallas statistics
+    kernel for large vocabularies, the plain XLA program below it."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.images.core import GrayScaler, PixelScaler
+    from keystone_tpu.ops.images.fisher_vector import (
+        FisherVector,
+        FisherVectorFused,
+    )
+    from keystone_tpu.ops.images.lcs import LCSExtractor
+    from keystone_tpu.ops.images.sift import SIFTExtractor
+    from keystone_tpu.ops.learning import BatchPCATransformer
+    from keystone_tpu.ops.learning.gmm import GaussianMixtureModel
+    from keystone_tpu.ops.stats import (
+        NormalizeRows,
+        SignedHellingerMapper,
+    )
+    from keystone_tpu.ops.util.nodes import (
+        FloatToDouble,
+        MatrixVectorizer,
+        VectorCombiner,
+    )
+    from keystone_tpu.workflow.api import Pipeline
+
+    def branch(prefix, in_dim):
+        pca = jnp.asarray(
+            rng.standard_normal((desc_dim, in_dim)).astype(np.float32)
+            * 0.1
+        )
+        gmm = GaussianMixtureModel(
+            jnp.asarray(
+                rng.standard_normal((desc_dim, vocab)), jnp.float32
+            ),
+            jnp.ones((desc_dim, vocab), jnp.float32),
+            jnp.ones((vocab,), jnp.float32) / vocab,
+        )
+        fv = (
+            FisherVectorFused(gmm) if vocab >= 32 else FisherVector(gmm)
+        )
+        return (
+            prefix
+            .and_then(BatchPCATransformer(pca.T))
+            .and_then(fv)
+            .and_then(FloatToDouble())
+            .and_then(MatrixVectorizer())
+            .and_then(NormalizeRows())
+            .and_then(SignedHellingerMapper())
+            .and_then(NormalizeRows())
+        )
+
+    sift = branch(
+        PixelScaler().and_then(GrayScaler())
+        .and_then(SIFTExtractor(
+            step=sift_step, bin=sift_bin, num_scales=sift_scales,
+            scale_step=sift_scale_step,
+        ))
+        .and_then(SignedHellingerMapper()),
+        128,
+    )
+    lcs = branch(
+        LCSExtractor(lcs_stride, lcs_border, lcs_patch).to_pipeline(),
+        96,
+    )
+    return Pipeline.gather([sift, lcs]).and_then(VectorCombiner())
+
+
+def build_flagship_featurize_pipeline(
+    img: int = 64,
+    desc_dim: int = 16,
+    vocab: int = 16,
+    *,
+    sift_step: int = 4,
+    sift_bin: int = 4,
+    sift_scales: int = 2,
+    sift_scale_step: int = 1,
+    lcs_stride: int = 4,
+    lcs_border: int = 16,
+    lcs_patch: int = 6,
+    seed: int = 7,
+    fit_images: Optional[Any] = None,
+) -> Tuple[object, int]:
+    """The flagship SIFT+LCS→FV featurize chain as a frozen serving
+    stage — raw ``(img, img, 3)`` uint8 in, ``(2·2·desc_dim·vocab,)``
+    f32 features out. Returns ``(fitted_featurize, feature_dim)``.
+
+    With ``fit_images`` (a ``Dataset`` of ``(img, img, 3)`` images, or
+    an array convertible to one) the PCA projections and GMMs are FIT
+    through the reference estimator path
+    (``compute_pca_and_fisher_branch``: ColumnSampler → ColumnPCA,
+    sampled+projected descriptors → GMM); without it, a seeded
+    warm-start stands in (``flagship_pipeline``) — deterministic
+    parameters, identical graph, which is what gateway startup, the
+    bench A/B, and the AOT fingerprint tests need. Both paths freeze to
+    the same pure-JAX branched DAG; ``feature_dim`` is probed off a
+    zero image through ``_batch_run`` — the exact staging surface the
+    serving engine fuses.
+
+    The default geometry (64² raw, 2 SIFT scales, 16-word vocab) keeps
+    the CPU smoke under a minute while exercising every node class of
+    the full-size chain; ``img`` must cover the LCS border
+    (``img > 2·lcs_border``) and the SIFT sampling bounds."""
+    import jax.numpy as jnp
+
+    if img <= 2 * lcs_border:
+        raise ValueError(
+            f"img={img} leaves the LCS keypoint grid empty "
+            f"(needs img > 2*lcs_border = {2 * lcs_border})"
+        )
+    if fit_images is None:
+        pipe = flagship_pipeline(
+            np.random.default_rng(seed), desc_dim, vocab,
+            sift_step=sift_step, sift_bin=sift_bin,
+            sift_scales=sift_scales, sift_scale_step=sift_scale_step,
+            lcs_stride=lcs_stride, lcs_border=lcs_border,
+            lcs_patch=lcs_patch,
+        )
+    else:
+        from keystone_tpu.ops.images.core import GrayScaler, PixelScaler
+        from keystone_tpu.ops.images.lcs import LCSExtractor
+        from keystone_tpu.ops.images.sift import SIFTExtractor
+        from keystone_tpu.ops.stats import SignedHellingerMapper
+        from keystone_tpu.ops.util.nodes import VectorCombiner
+        from keystone_tpu.parallel.dataset import Dataset
+        from keystone_tpu.pipelines.images.imagenet_sift_lcs_fv import (
+            ImageNetSiftLcsFVConfig,
+            compute_pca_and_fisher_branch,
+        )
+        from keystone_tpu.workflow.api import Pipeline
+
+        if not isinstance(fit_images, Dataset):
+            fit_images = Dataset.from_items(
+                [np.asarray(x) for x in fit_images]
+            )
+        conf = ImageNetSiftLcsFVConfig(
+            desc_dim=desc_dim, vocab_size=vocab, seed=seed,
+            sift_scale_step=sift_scale_step, lcs_stride=lcs_stride,
+            lcs_border=lcs_border, lcs_patch=lcs_patch,
+        )
+        sift_prefix = (
+            PixelScaler().and_then(GrayScaler())
+            .and_then(SIFTExtractor(
+                step=sift_step, bin=sift_bin, num_scales=sift_scales,
+                scale_step=sift_scale_step,
+            ))
+            .and_then(SignedHellingerMapper())
+        )
+        lcs_prefix = LCSExtractor(
+            lcs_stride, lcs_border, lcs_patch
+        ).to_pipeline()
+        pipe = Pipeline.gather([
+            compute_pca_and_fisher_branch(
+                sift_prefix, fit_images, conf, None, None
+            ),
+            compute_pca_and_fisher_branch(
+                lcs_prefix, fit_images, conf, None, None
+            ),
+        ]).and_then(VectorCombiner())
+    fitted = pipe.fit()
+    feat_dim = int(
+        np.asarray(
+            fitted._batch_run(jnp.zeros((1, img, img, 3), jnp.uint8))
+        ).shape[-1]
+    )
+    return fitted, feat_dim
+
+
+__all__ = [
+    "build_featurize_pipeline",
+    "build_flagship_featurize_pipeline",
+    "flagship_pipeline",
+]

@@ -46,6 +46,35 @@ def test_fused_matches_unfused_batch():
     np.testing.assert_allclose(out_fused, out_plain, rtol=1e-3, atol=1e-4)
 
 
+def test_auto_interpret_parity_vs_numpy_reference():
+    """``fisher_vector_stats_pallas`` with NO interpret argument
+    anywhere in the call chain: the backend auto-selection
+    (``pallas_kernels.auto_interpret``) picks the Pallas interpreter
+    off-TPU, and the auto-selected path matches the INDEPENDENT numpy
+    FV reference (test_sift_fv._np_fisher_vector) — parity against the
+    spec translation, not merely against the jax program it fuses."""
+    import jax
+
+    from keystone_tpu.ops.images.pallas_kernels import auto_interpret
+    from test_sift_fv import _np_fisher_vector
+
+    assert auto_interpret(None) == (jax.default_backend() != "tpu")
+
+    gmm = _random_model(d=8, k=32, seed=5)
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((8, 150)).astype(np.float32)
+    got = np.asarray(FisherVectorFused(gmm).apply(x))
+    want = _np_fisher_vector(
+        np.asarray(gmm.means, np.float64),
+        np.asarray(gmm.variances, np.float64),
+        np.asarray(gmm.weights, np.float64),
+        x.astype(np.float64),
+        thresh=gmm.weight_threshold,
+    )
+    assert got.shape == want.shape == (8, 64)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
 def test_optimizable_choice_by_k():
     small = GMMFisherVectorEstimator(k=8)
     large = GMMFisherVectorEstimator(k=32)

@@ -83,6 +83,41 @@ def test_batches_fixed_shape_and_tail_padding(tar_dir):
     assert all_labels == [c for c in range(4) for _ in range(5)]
 
 
+def test_featurized_batches_rides_fused_engine(tar_dir):
+    """The fit-path loaders ride the SAME fused engine serving runs
+    (``featurized_batches``): raw uint8 on the H2D wire with exact
+    byte accounting, ONE compiled program, and features identical to
+    driving the engine over ``batches()`` by hand."""
+    loc, labels = tar_dir
+    from keystone_tpu.serving.featurize import build_featurize_pipeline
+
+    feat, feat_d = build_featurize_pipeline(img=16)
+    engine = feat.compiled(buckets=(8,), aot_store=False)
+    loader = StreamingImageNetLoader(
+        loc, labels, decode_size=16, shard_index=0, num_shards=1
+    )
+    outs, labs_all, tot = [], [], 0
+    for feats, labs, n_valid in loader.featurized_batches(engine, 8):
+        outs.append(np.asarray(feats)[:n_valid])
+        labs_all += labs
+        tot += n_valid
+    assert tot == len(labs_all) == 20
+    got = np.concatenate(outs)
+    assert got.shape == (20, feat_d)
+    # 3 dispatches of the (8, 16, 16, 3) uint8 staging buffer — raw
+    # pixels, never f32, padding included (real wire traffic)
+    assert engine.metrics.h2d_bytes.total == 3 * 8 * 16 * 16 * 3
+    assert engine.metrics.compile_count == 1
+
+    want = np.concatenate([
+        np.asarray(engine.apply(u8, sync=True))[:nv]
+        for u8, _, nv in StreamingImageNetLoader(
+            loc, labels, decode_size=16, shard_index=0, num_shards=1
+        ).batches(8, np.uint8)
+    ])
+    np.testing.assert_array_equal(got, want)
+
+
 def test_cycle_and_limit(tar_dir):
     loc, labels = tar_dir
     loader = StreamingImageNetLoader(

@@ -431,6 +431,57 @@ def test_featurize_cross_load_falls_back_counted(tmp_path):
     )
 
 
+def test_flagship_featurize_roundtrip_zero_compiles(tmp_path):
+    """The flagship SIFT+LCS->FV chain — branched DAG, Pallas hot
+    loops — through the AOT store: the save generation compiles, a
+    second engine HITS with zero traces/compiles and serves bitwise-
+    equal outputs (the serialized executable covers the whole fused
+    program, Pallas lowering included), and ``pipeline_token``
+    distinguishes the flagship chain from the demo conv chain so their
+    entries can never collide."""
+    from keystone_tpu.serving.aot import pipeline_token
+    from keystone_tpu.serving.featurize import (
+        build_featurize_pipeline,
+        build_flagship_featurize_pipeline,
+    )
+
+    IMG = 34  # > the LCS keypoint border (2*16)
+    flagship, feat_d = build_flagship_featurize_pipeline(
+        img=IMG, desc_dim=8, vocab=8
+    )
+    model = build_pipeline(d=feat_d, hidden=8, depth=2)
+    store = make_store(tmp_path)
+    raw = np.random.default_rng(9).integers(
+        0, 256, (3, IMG, IMG, 3), dtype=np.uint8
+    )
+
+    def engine(name):
+        eng = model.compiled(
+            buckets=(4,), featurize=flagship, aot_store=store, name=name
+        )
+        eng.warmup(example=jnp.zeros((IMG, IMG, 3), jnp.uint8))
+        return eng
+
+    e1 = engine("aot-fl-1")
+    assert statuses(e1) == {4: "saved"}
+    out1 = np.asarray(e1.apply(raw, sync=True))
+
+    e2 = engine("aot-fl-2")
+    assert statuses(e2) == {4: "hit"}
+    assert e2.metrics.compile_count == 0
+    np.testing.assert_array_equal(
+        np.asarray(e2.apply(raw, sync=True)), out1
+    )
+
+    # the flagship fingerprint is its own: a demo conv chain with the
+    # same uint8 input spec can never share an entry
+    demo, _ = build_featurize_pipeline(img=IMG)
+    assert pipeline_token(flagship) != pipeline_token(demo)
+    assert pipeline_token(flagship) == pipeline_token(
+        build_flagship_featurize_pipeline(img=IMG, desc_dim=8, vocab=8)[0]
+    )
+
+
 # -- observability ---------------------------------------------------------
 
 def test_metrics_families_on_scrape(tmp_path, fitted):

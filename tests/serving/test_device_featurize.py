@@ -134,6 +134,50 @@ def test_batcher_raw_uint8_serial_vs_pipelined_bitwise(model, featurize):
         np.testing.assert_array_equal(a, b)
 
 
+# -- the flagship chain ----------------------------------------------------
+
+FIMG = 34  # must clear the LCS keypoint border (img > 2*16)
+
+
+@pytest.fixture(scope="module")
+def flagship():
+    from keystone_tpu.serving.featurize import (
+        build_flagship_featurize_pipeline,
+    )
+
+    # smallest honest geometry: every node class of the full chain
+    # (gray->SIFT and LCS branches, PCA, GMM FV, Hellinger/L2, gather,
+    # combine) at compile costs a CPU test run can afford
+    return build_flagship_featurize_pipeline(
+        img=FIMG, desc_dim=8, vocab=8
+    )
+
+
+def test_flagship_branched_dag_fuses_and_matches_two_stage(flagship):
+    """The tentpole seam contract on the BRANCHED flagship DAG: the
+    gather/combine graph composes through ``CompiledPipeline
+    (featurize=)`` exactly like a linear chain — one program per
+    bucket, raw uint8 staged and accounted exactly, fused outputs
+    matching the two-stage host path at the repo's fusion tolerance
+    (single-program XLA reassociates float ops across the seam)."""
+    feat, feat_d = flagship
+    model = build_pipeline(d=feat_d, hidden=8, depth=2)
+    eng = model.compiled(
+        buckets=(2, 4), featurize=feat, aot_store=False, name="dfz-fl"
+    )
+    eng.warmup(example=jnp.zeros((FIMG, FIMG, C), jnp.uint8))
+    assert eng.metrics.compile_count == len(eng.buckets)
+    rng = np.random.default_rng(21)
+    raw = rng.integers(0, 256, (3, FIMG, FIMG, C), dtype=np.uint8)
+    got = np.asarray(eng.apply(raw, sync=True))
+    feats = feat._batch_run(jnp.asarray(raw))
+    want = np.asarray(model._batch_run(feats))[:3]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # no retrace on dispatch, and the wire carried raw pixels
+    assert eng.metrics.compile_count == len(eng.buckets)
+    assert eng.metrics.h2d_bytes.snapshot() == {4: 4 * FIMG * FIMG * C}
+
+
 def test_gateway_device_featurize_swap_keeps_fused_stage(model, featurize):
     """The full request plane over raw inputs: predicts match the
     two-stage reference, and a forced live rebucket rebuilds lane
