@@ -28,13 +28,19 @@ def post_roster(
     route: str,
     replica_url: str,
     timeout_s: float = 5.0,
+    models=None,
 ) -> None:
     """POST one replica URL to a router roster route (``/registerz``
     or ``/deregisterz``). Raises on any transport/HTTP failure — the
-    caller owns the retry policy."""
-    body = json.dumps(
-        {"url": replica_url.rstrip("/")}
-    ).encode("utf-8")
+    caller owns the retry policy. ``models`` (an iterable of model
+    ids) advertises which zoo models the replica serves: the router
+    only forwards ``/predict/<model>`` to replicas advertising that
+    id. Omitted entirely when empty, so pre-zoo routers keep parsing
+    the same ``{"url": ...}`` body they always did."""
+    doc = {"url": replica_url.rstrip("/")}
+    if models:
+        doc["models"] = sorted(str(m) for m in models)
+    body = json.dumps(doc).encode("utf-8")
     req = urllib.request.Request(
         router_url.rstrip("/") + route,
         data=body,

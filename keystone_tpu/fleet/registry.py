@@ -105,6 +105,10 @@ class Replica:
         # federation inputs cached from the last probe scrape
         self._last_scrape = None  # guarded-by: _lock
         self._build: Dict[str, str] = {}  # guarded-by: _lock
+        # zoo model ids this replica advertises (registration +
+        # heartbeat refreshes); empty = pre-zoo replica, which only
+        # receives bare-/predict traffic
+        self._models: frozenset = frozenset()  # guarded-by: _lock
 
     # -- routing signals ----------------------------------------------------
 
@@ -157,6 +161,19 @@ class Replica:
     def cached_scrape(self) -> Optional[str]:
         with self._lock:
             return self._last_scrape
+
+    @property
+    def models(self) -> frozenset:
+        with self._lock:
+            return self._models
+
+    def set_models(self, models) -> None:
+        with self._lock:
+            self._models = frozenset(str(m) for m in models)
+
+    def advertises(self, model: str) -> bool:
+        with self._lock:
+            return model in self._models
 
     # -- request-path accounting (the router's forward path) ----------------
 
@@ -233,6 +250,7 @@ class Replica:
                     else None
                 ),
                 "build": dict(self._build),
+                "models": sorted(self._models),
             }
         # state/healthy re-take the lock; cheap, and keeps one
         # source of truth for the half-open arithmetic
@@ -274,15 +292,19 @@ class ReplicaRegistry:
     # -- membership ---------------------------------------------------------
 
     def add(
-        self, url: str, source: str = "registered"
+        self, url: str, source: str = "registered", models=None
     ) -> Tuple[Replica, bool]:
         """Add one replica (idempotent by URL). Returns ``(replica,
         created)`` — a re-registration of a known URL is a heartbeat,
-        not a new member."""
+        not a new member (but it DOES refresh the advertised model
+        set: a replica whose zoo spec changed re-registers with the
+        new ids)."""
         url = _validate_replica_url(url)
         with self._lock:
             existing = self._replicas.get(url)
             if existing is not None:
+                if models is not None:
+                    existing.set_models(models)
                 return existing, False
             replica = Replica(
                 url,
@@ -293,6 +315,8 @@ class ReplicaRegistry:
             )
             self._next_index += 1
             self._replicas[url] = replica
+        if models:
+            replica.set_models(models)
         logger.info(
             "fleet %s: replica %s added (%s, index %d)",
             self.name, replica.name, source, replica.index,
@@ -337,16 +361,26 @@ class ReplicaRegistry:
 
     # -- routing ------------------------------------------------------------
 
-    def pick(self, exclude: Sequence[Replica] = ()) -> Optional[Replica]:
+    def pick(
+        self,
+        exclude: Sequence[Replica] = (),
+        model: Optional[str] = None,
+    ) -> Optional[Replica]:
         """The least-loaded ready+healthy replica outside ``exclude``
         — with the pool's availability-over-purity fallbacks: a
         healthy-but-draining replica beats nothing, and an unhealthy
         replica beats shedding when it is all that's left (which is
-        also how a half-open replica earns its probe traffic)."""
+        also how a half-open replica earns its probe traffic).
+        ``model`` restricts every tier to replicas ADVERTISING that
+        zoo model id — the fallbacks relax health, never routing a
+        model to a replica that doesn't serve it (None here means
+        'no replica for model', the router's typed 503)."""
         # ONE membership snapshot for all three tiers: the hot path
         # takes the registry lock once, and the fallbacks filter the
         # same roster the first tier saw
         available = [r for r in self.replicas() if r not in exclude]
+        if model is not None:
+            available = [r for r in available if r.advertises(model)]
         candidates = [r for r in available if r.healthy and r.ready]
         if not candidates:
             candidates = [r for r in available if r.healthy]

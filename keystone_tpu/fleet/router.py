@@ -23,9 +23,19 @@ gateway frontend (``observability/httpd.py``). Routes:
   500-ing fleet must look like one, not like a typed shed); only when
   no replica is reachable at all does the router shed typed itself
   (503 ``overloaded``/``closed``).
+- ``POST /predict/<model>`` — the model-zoo route: forwarded with the
+  PATH PRESERVED to the least-loaded replica ADVERTISING that model
+  id (the ``models`` list in its registration), so the replica's own
+  zoo resolves the model and its typed ``unknown_model`` 404 reaches
+  the client verbatim. When NO replica advertises the id, the router
+  answers a typed 503 ``{"error": "no_replica_for_model",
+  "model": ...}`` — a routing fact, distinct from overload.
 - ``POST /registerz`` — ``{"url": "http://host:port"}``
   self-registration (what ``serve-gateway --register`` POSTs at
-  startup); idempotent per URL, so re-registration is a heartbeat.
+  startup); idempotent per URL, so re-registration is a heartbeat —
+  one that also REFRESHES the optional ``"models": [...]`` advertised
+  zoo model ids (``serve-gateway --zoo --register`` sends its
+  registry's ids).
 - ``POST /deregisterz`` — ``{"url": "http://host:port"}`` roster
   REMOVAL (idempotent): no new forwards land on the replica from the
   moment this returns, which is the first step of graceful
@@ -288,9 +298,9 @@ class _RouterHandler(JsonHandler):
             else:
                 self._send_text(
                     404,
-                    "not found; try /predict /registerz /deregisterz "
-                    "/fleetz /readyz /healthz /metrics /slz /tracez "
-                    "/debugz /chaosz\n",
+                    "not found; try /predict /predict/<model> "
+                    "/registerz /deregisterz /fleetz /readyz /healthz "
+                    "/metrics /slz /tracez /debugz /chaosz\n",
                 )
         except Exception as e:
             logger.exception("router GET error for %s", self.path)
@@ -300,8 +310,11 @@ class _RouterHandler(JsonHandler):
         path = urlparse(self.path).path
         self._trace_id = None  # _predict adopts/mints; see _send
         try:
-            if path == "/predict":
-                self._predict()
+            if path == "/predict" or path.startswith("/predict/"):
+                model_id = path[len("/predict/"):] if (
+                    path.startswith("/predict/")
+                ) else None
+                self._predict(model_id or None)
             elif path == "/registerz":
                 self._registerz()
             elif path == "/deregisterz":
@@ -310,8 +323,8 @@ class _RouterHandler(JsonHandler):
                 self._chaosz()
             else:
                 self._send_text(
-                    404, "not found; try /predict /registerz "
-                    "/deregisterz /chaosz\n"
+                    404, "not found; try /predict /predict/<model> "
+                    "/registerz /deregisterz /chaosz\n"
                 )
         except Exception as e:
             logger.exception("router POST error for %s", self.path)
@@ -370,7 +383,7 @@ class _RouterHandler(JsonHandler):
             line["error"] = error
         self.server.write_request_log(line)  # type: ignore[attr-defined]
 
-    def _predict(self) -> None:
+    def _predict(self, model_id: Optional[str] = None) -> None:
         body = self._read_body()
         t0 = time.perf_counter()
         self._t_wall = time.time()  # arrival clock for the request log
@@ -404,7 +417,10 @@ class _RouterHandler(JsonHandler):
         untyped_fallback: Optional[Tuple[int, bytes]] = None
         retry_reason: Optional[str] = None
         for _attempt in range(max_retries + 1):
-            replica = self.fleet.pick(exclude=tried)
+            # a named model only routes to replicas ADVERTISING it
+            # (registration's "models" list) — the health fallbacks
+            # inside pick() never widen past the advertiser set
+            replica = self.fleet.pick(exclude=tried, model=model_id)
             if replica is None:
                 break
             tried.append(replica)
@@ -448,7 +464,11 @@ class _RouterHandler(JsonHandler):
                     traceparent = None
             try:
                 status, payload, ctype = self._forward(
-                    replica, body, traceparent
+                    replica, body, traceparent,
+                    path=(
+                        "/predict" if model_id is None
+                        else f"/predict/{model_id}"
+                    ),
                 )
                 span.set_attr("status", status)
             except ReplicaUnavailable as e:
@@ -535,6 +555,28 @@ class _RouterHandler(JsonHandler):
                 status, payload, "application/json; charset=utf-8"
             )
             return
+        if model_id is not None and not tried:
+            # a roster may exist yet hold NO advertiser for this model
+            # — that is a routing fact, not overload, and the typed
+            # body says which model the fleet can't place
+            self.metrics.record_outcome("shed")
+            if request_log:
+                self._log_request(
+                    503, time.perf_counter() - t0, 0, None, body,
+                    error=f"no replica advertises model {model_id}",
+                )
+            self._send_json(
+                {
+                    "error": "no_replica_for_model",
+                    "model": model_id,
+                    "detail": (
+                        f"none of {len(self.fleet)} replicas "
+                        f"advertises model {model_id!r}"
+                    ),
+                },
+                code=503,
+            )
+            return
         self.metrics.record_outcome("shed")
         if request_log:
             self._log_request(
@@ -558,10 +600,14 @@ class _RouterHandler(JsonHandler):
         replica,
         body: bytes,
         traceparent: Optional[str] = None,
+        path: str = "/predict",
     ) -> Tuple[int, bytes, str]:
         """POST the raw /predict body to one replica (plus the W3C
         ``traceparent`` when the request is traced — the replica
-        adopts its trace id). Returns ``(status, payload,
+        adopts its trace id). ``path`` is PRESERVED on the forward —
+        a ``/predict/<model>`` request reaches the replica under the
+        same model id the client named, so the replica's zoo (not the
+        router) owns model resolution. Returns ``(status, payload,
         content_type)`` for any response the client should see
         verbatim; raises ``ReplicaUnavailable`` for outcomes worth
         trying another replica for."""
@@ -584,7 +630,7 @@ class _RouterHandler(JsonHandler):
         if traceparent is not None:
             headers[TRACEPARENT_HEADER] = traceparent
         req = urllib.request.Request(
-            replica.url + "/predict",
+            replica.url + path,
             data=body,
             headers=headers,
             method="POST",
@@ -672,8 +718,20 @@ class _RouterHandler(JsonHandler):
                 detail='want {"url": "http://host:port"}',
             )
             return
+        models = doc.get("models")
+        if models is not None and (
+            not isinstance(models, list)
+            or not all(isinstance(m, str) for m in models)
+        ):
+            self._send_error_json(
+                400, "bad_request",
+                detail='"models" must be a list of model-id strings',
+            )
+            return
         try:
-            replica, created = self.fleet.add(url, source="registered")
+            replica, created = self.fleet.add(
+                url, source="registered", models=models
+            )
         except ValueError as e:
             self._send_error_json(400, "bad_request", detail=str(e))
             return
@@ -684,6 +742,7 @@ class _RouterHandler(JsonHandler):
                 "index": replica.index,
                 "replicas": len(self.fleet),
                 "probe_interval_s": self.fleet.probe_interval_s,
+                "models": sorted(replica.models),
             }
         )
 

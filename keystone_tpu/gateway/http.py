@@ -20,6 +20,19 @@ A stdlib ``http.server`` on a background daemon thread, following the
   the caller's trace id, and every response — success AND typed
   shed — echoes it as ``X-Keystone-Trace`` (with tracing on and no
   inbound context, this process roots the trace itself).
+- ``POST /predict/<model>`` — the model-zoo route (``--zoo``): same
+  body/contract, served by the NAMED model's own gateway unit with
+  that model's input dtype; bare ``/predict`` keeps serving the zoo's
+  default model, so single-model clients survive the upgrade. An id
+  the registry doesn't know gets a TYPED 404 —
+  ``{"error": "unknown_model", "model": ..., "registered": [...]}`` —
+  instead of prose (the fleet router forwards the path and relays
+  this body verbatim). Without ``--zoo`` the route 404s the same way
+  with an empty ``registered`` list.
+- ``GET /planz`` — zoo mode only: the applied ``PlacementPlan`` (or
+  null when serving on spec flags) next to every model's ACTUAL shape
+  (resident/cold, lanes, buckets, shared-prefix membership) — the
+  plan-vs-actual audit surface of ``--optimize``.
 - ``GET /readyz`` — 200 while the gateway admits, 503 once draining.
   READINESS, not liveness: the admin endpoint's ``/healthz`` answers
   "is the process up", this answers "should the load balancer route
@@ -137,15 +150,39 @@ class _Handler(JsonHandler):
         self._send_json({"error": error, **extra}, code=code)
 
     @property
+    def zoo(self):
+        return self.server.zoo  # type: ignore[attr-defined]
+
+    @property
     def gateway(self) -> Gateway:
-        return self.server.gateway  # type: ignore[attr-defined]
+        gw = self.server.gateway  # type: ignore[attr-defined]
+        if gw is None:
+            # zoo mode: single-gateway routes (/swap's non-zoo shape,
+            # legacy callers) act on the DEFAULT model's unit
+            zoo = self.zoo
+            return zoo.gateway_for(zoo.registry.default_id)
+        return gw
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib handler API)
         url = urlparse(self.path)
         path = url.path
         self._trace_id = None  # per-request (keep-alive safety)
         try:
-            if path == "/readyz":
+            if path == "/readyz" and self.zoo is not None:
+                # zoo readiness: every RESIDENT unit admitting; load
+                # is the sum across units (cold models contribute 0 —
+                # they hold no queue to be loaded on)
+                zoo = self.zoo
+                load_headers = {
+                    "X-Keystone-Load": str(zoo.total_load())
+                }
+                if zoo.ready:
+                    self._send_text(200, "ok\n", headers=load_headers)
+                else:
+                    self._send_text(
+                        503, "draining\n", headers=load_headers
+                    )
+            elif path == "/readyz":
                 # the load-report header: queued + in-lane requests,
                 # so the fleet router's probe reads this replica's
                 # routing load without a full /metrics scrape
@@ -186,6 +223,15 @@ class _Handler(JsonHandler):
                     registry.collect(), self.headers.get("Accept")
                 )
                 self._send(200, body.encode("utf-8"), ctype)
+            elif path == "/planz":
+                if self.zoo is None:
+                    self._send_error_json(
+                        404, "no_zoo",
+                        detail="started without --zoo; /planz reports "
+                               "the model-zoo placement plan",
+                    )
+                else:
+                    self._send_json(self.zoo.planz(), indent=1)
             elif path == "/slz":
                 self._send_json(slo_mod.slz_status(), indent=1)
             elif path == "/debugz":
@@ -228,8 +274,9 @@ class _Handler(JsonHandler):
             else:
                 self._send_text(
                     404,
-                    "not found; try /predict /readyz /healthz /metrics "
-                    "/slz /debugz /tracez /profilez /chaosz\n",
+                    "not found; try /predict /predict/<model> /planz "
+                    "/readyz /healthz /metrics /slz /debugz /tracez "
+                    "/profilez /chaosz\n",
                 )
         except Exception as e:
             logger.exception("gateway GET error for %s", self.path)
@@ -271,6 +318,9 @@ class _Handler(JsonHandler):
                 else meta.get("deadline_ms")
             ),
             "post_seq": meta.get("post_seq"),
+            # zoo mode: which named model served the instance (None on
+            # the bare single-model route; replay targets the same id)
+            "model": meta.get("model"),
         }
         if error is not None:
             line["error"] = error
@@ -290,28 +340,42 @@ class _Handler(JsonHandler):
         # fills it once the body parses
         self._log_meta = {}
         try:
-            if path == "/predict":
-                self._predict()
+            if path == "/predict" or path.startswith("/predict/"):
+                model_id = path[len("/predict/"):] if (
+                    path.startswith("/predict/")
+                ) else None
+                self._predict(model_id or None)
             elif path == "/chaosz":
                 self._chaosz()
             elif path == "/swap":
-                swapped = self.gateway.rebucket(force=True)
-                self._send_json(
-                    {
-                        "swapped": swapped,
-                        "buckets": list(self.gateway.buckets),
-                    }
-                )
+                if self.zoo is not None:
+                    self._send_json(
+                        {"swapped": self.zoo.rebucket(force=True)}
+                    )
+                else:
+                    swapped = self.gateway.rebucket(force=True)
+                    self._send_json(
+                        {
+                            "swapped": swapped,
+                            "buckets": list(self.gateway.buckets),
+                        }
+                    )
             elif path == "/drain":
+                target = (
+                    self.zoo.close if self.zoo is not None
+                    else self.gateway.close
+                )
                 threading.Thread(
-                    target=self.gateway.close,
+                    target=target,
                     name="keystone-gateway-drain",
                     daemon=True,
                 ).start()
                 self._send_json({"draining": True})
             else:
                 self._send_text(
-                    404, "not found; try /predict /swap /drain /chaosz\n"
+                    404,
+                    "not found; try /predict /predict/<model> /swap "
+                    "/drain /chaosz\n",
                 )
         except Overloaded as e:
             code = _status_for(e)
@@ -388,7 +452,7 @@ class _Handler(JsonHandler):
             return
         self._send_json(injector.status(), indent=1)
 
-    def _predict(self) -> None:
+    def _predict(self, model_id: Optional[str] = None) -> None:
         # W3C trace adoption FIRST, before the body can 400 or
         # admission can shed: the router (or any tracing caller) sent
         # a `traceparent`, and EVERY response — success, typed shed,
@@ -400,6 +464,37 @@ class _Handler(JsonHandler):
             self._trace_id = ctx.trace_id
         elif get_tracer().enabled:
             self._trace_id = new_trace_id()
+        # model resolution before the body parse: an unknown id is a
+        # typed 404 regardless of payload shape, and the error carries
+        # the registered ids so the client can correct itself
+        zoo = self.zoo
+        if zoo is not None:
+            from keystone_tpu.zoo.registry import UnknownModel
+
+            try:
+                model_id, spec = zoo.resolve(model_id)
+            except UnknownModel as e:
+                self._send_error_json(
+                    404, "unknown_model", model=e.model_id,
+                    registered=list(e.registered),
+                )
+                return
+            dtype = np.dtype(spec.input_dtype)
+
+            def submit(ex, **kw):
+                return zoo.predict(ex, model_id, **kw)
+
+        elif model_id is not None:
+            # single-model deployment: no named routes exist at all
+            self._send_error_json(
+                404, "unknown_model", model=model_id, registered=[],
+                detail="single-model deployment (started without "
+                       "--zoo); POST bare /predict",
+            )
+            return
+        else:
+            dtype = self.server.input_dtype  # type: ignore[attr-defined]
+            submit = self.gateway.predict
         try:
             doc = json.loads(self._read_body() or b"{}")
             instances = doc["instances"]
@@ -420,7 +515,6 @@ class _Handler(JsonHandler):
                        f"got {deadline_ms!r}",
             )
             return
-        dtype = self.server.input_dtype  # type: ignore[attr-defined]
         try:
             # OverflowError: an out-of-range integer against a narrow
             # dtype (a 256 pixel under --device-featurize's uint8) is
@@ -437,6 +531,7 @@ class _Handler(JsonHandler):
             "shape": list(examples[0].shape),
             "deadline_ms": deadline_ms,
             "post_seq": next_post_seq(),
+            "model": model_id,
         }
         # admit every instance BEFORE waiting on any: concurrent
         # instances coalesce into shared micro-batch windows. Every
@@ -447,7 +542,7 @@ class _Handler(JsonHandler):
         try:
             for ex in examples:
                 futures.append(
-                    self.gateway.predict(
+                    submit(
                         ex,
                         deadline_ms=deadline_ms,
                         trace_id=self._trace_id,
@@ -512,13 +607,14 @@ class GatewayServer(BackgroundServer, device_obs.MemorySamplerHost):
 
     def __init__(
         self,
-        gateway: Gateway,
+        gateway: Optional[Gateway] = None,
         port: int = 0,
         host: str = "127.0.0.1",
         registry=None,
         input_dtype: Any = np.float32,
         request_log: Any = False,
         chaos_routes: bool = True,
+        zoo=None,
     ):
         """``request_log``: falsy = off; True = one JSON line per
         /predict instance on stdout; a path string = append the lines
@@ -527,9 +623,18 @@ class GatewayServer(BackgroundServer, device_obs.MemorySamplerHost):
         removes the /chaosz fault-injection surface from this
         frontend (a production deployment that is not a chaos
         experiment shouldn't expose sabotage routes to anyone who
-        can reach /predict)."""
+        can reach /predict). ``zoo`` (a ``ModelZoo``) replaces
+        ``gateway``: /predict/<model> routes by id, bare /predict
+        serves the default model with ITS input dtype (the
+        ``input_dtype`` arg only applies to single-gateway mode), and
+        /planz reports plan-vs-actual."""
+        if (gateway is None) == (zoo is None):
+            raise ValueError(
+                "GatewayServer wants exactly one of gateway= or zoo="
+            )
         super().__init__(port=port, host=host)
         self.gateway = gateway
+        self.zoo = zoo
         self.registry = (
             registry if registry is not None else get_global_registry()
         )
@@ -548,6 +653,7 @@ class GatewayServer(BackgroundServer, device_obs.MemorySamplerHost):
 
     def _configure(self, httpd) -> None:
         httpd.gateway = self.gateway
+        httpd.zoo = self.zoo
         httpd.registry = self.registry
         httpd.input_dtype = self.input_dtype
         httpd.request_log = self.request_log
@@ -575,6 +681,7 @@ def register_with_router(
     attempts: int = 30,
     interval_s: float = 1.0,
     cancel: Optional[threading.Event] = None,
+    models=None,
 ) -> bool:
     """POST this gateway's base URL to a fleet router's ``/registerz``
     (``serve-gateway --register``). Retries: replicas and their router
@@ -583,14 +690,19 @@ def register_with_router(
     a first one. ``cancel`` stops the retry loop: the DRAIN path sets
     it before deregistering, or a straggling retry could re-register
     a replica that is already exiting — recreating exactly the
-    lingering-roster-entry gap deregistration closes."""
+    lingering-roster-entry gap deregistration closes. ``models``
+    advertises the zoo model ids this replica serves (zoo mode) so
+    the router can route ``/predict/<model>`` to it."""
     from keystone_tpu.fleet.client import REGISTER_ROUTE, post_roster
 
     for attempt in range(attempts):
         if cancel is not None and cancel.is_set():
             return False
         try:
-            post_roster(router_url, REGISTER_ROUTE, own_url, timeout_s=10)
+            post_roster(
+                router_url, REGISTER_ROUTE, own_url, timeout_s=10,
+                models=models,
+            )
             logger.info(
                 "registered %s with router %s", own_url, router_url
             )
@@ -698,6 +810,35 @@ def main(argv=None) -> int:
                     "the default advertises the BIND address, and "
                     "http://0.0.0.0:PORT means 'myself' to the "
                     "router, not to this replica")
+    ap.add_argument("--zoo", default=None, metavar="SPEC.json",
+                    help="serve a MODEL ZOO instead of one model: a "
+                    "JSON spec of named models (see "
+                    "keystone_tpu/zoo/registry.py for the format). "
+                    "POST /predict/<model> routes by id, bare "
+                    "/predict serves the spec's default model, GET "
+                    "/planz reports plan-vs-actual. Each model gets "
+                    "its own gateway lanes, metrics under its own "
+                    "name, and an AOT store namespace; co-hosted "
+                    "models with IDENTICAL featurize chains share one "
+                    "engine that computes the prefix once per window "
+                    "(cross-model CSE). Ignores the single-model "
+                    "flags (--d/--hidden/--depth/--device-featurize/"
+                    "--shard-model/--buckets/--lanes)")
+    ap.add_argument("--optimize", action="store_true",
+                    help="with --zoo: run the placement optimizer "
+                    "(zoo/optimizer.py) over the spec's expected-size "
+                    "histograms, measured param bytes, and the "
+                    "per-chip HBM budget, and host each model with "
+                    "the PLANNED buckets/lanes/sharding instead of "
+                    "its spec flags; /planz shows the plan next to "
+                    "the actual pool shapes")
+    ap.add_argument("--max-resident", type=int, default=None,
+                    metavar="N",
+                    help="with --zoo: cap how many models hold "
+                    "compiled engines at once; over the cap the "
+                    "least-recently-used unpinned model is evicted "
+                    "(drains in the background) and pages back in on "
+                    "its next request (default: all models resident)")
     ap.add_argument("--d", type=int, default=256)
     ap.add_argument("--hidden", type=int, default=512)
     ap.add_argument("--depth", type=int, default=4)
@@ -764,7 +905,49 @@ def main(argv=None) -> int:
 
     featurize = None
     input_dtype = np.float32
-    if args.device_featurize:
+    zoo = None
+    gateway = None
+    if args.zoo:
+        from keystone_tpu.zoo import ModelZoo, load_zoo_spec
+
+        model_registry = load_zoo_spec(args.zoo)
+        zoo = ModelZoo(model_registry, max_resident=args.max_resident)
+        if args.optimize:
+            import jax
+
+            from keystone_tpu.observability.device import (
+                chip_hbm_bytes,
+            )
+            from keystone_tpu.zoo.optimizer import (
+                ChipBudget,
+                plan_placement,
+            )
+
+            # plan BEFORE hosting: profiles(build=True) materializes
+            # params (cheap, host memory) so params_nbytes is measured
+            # not guessed; hosting then happens under the plan
+            zoo.plan = plan_placement(
+                zoo.profiles(build=True),
+                ChipBudget(
+                    hbm_bytes=chip_hbm_bytes(),
+                    n_chips=len(jax.devices()),
+                ),
+            )
+            print(
+                json.dumps({"plan": zoo.plan.to_dict()}), flush=True
+            )
+        if args.max_resident is None:
+            # everything resident up-front: one host() call, so CSE
+            # groups form across the whole spec
+            zoo.host()
+        else:
+            # capped: warm the pinned set + the default model now,
+            # the rest page in on first request
+            want = [s.model_id for s in model_registry if s.pinned]
+            if model_registry.default_id not in want:
+                want.append(model_registry.default_id)
+            zoo.host(want)
+    elif args.device_featurize:
         from keystone_tpu.serving.featurize import (
             build_featurize_pipeline,
             build_flagship_featurize_pipeline,
@@ -781,39 +964,59 @@ def main(argv=None) -> int:
         args.d = feat_d  # the model consumes the featurize output
         warmup_example = jnp.zeros((args.img, args.img, 3), jnp.uint8)
         input_dtype = np.uint8
-    fitted = build_pipeline(d=args.d, hidden=args.hidden, depth=args.depth)
-    if not args.device_featurize:
-        warmup_example = jnp.zeros((args.d,), jnp.float32)
-    if args.shard_model:
-        # pin the process mesh so EVERY engine generation (initial
-        # build, rebuckets, warm-pool swaps) places over the same
-        # (data, model) topology
-        import jax
+    if zoo is None:
+        fitted = build_pipeline(
+            d=args.d, hidden=args.hidden, depth=args.depth
+        )
+        if not args.device_featurize:
+            warmup_example = jnp.zeros((args.d,), jnp.float32)
+        if args.shard_model:
+            # pin the process mesh so EVERY engine generation (initial
+            # build, rebuckets, warm-pool swaps) places over the same
+            # (data, model) topology
+            import jax
 
-        from keystone_tpu.parallel import mesh as mesh_lib
+            from keystone_tpu.parallel import mesh as mesh_lib
 
-        n_model = args.mesh_model or len(jax.devices())
-        mesh_lib.set_mesh(mesh_lib.make_mesh(n_model=n_model))
-    gateway = Gateway(
-        fitted,
-        buckets=tuple(int(b) for b in args.buckets.split(",")),
-        n_lanes=args.lanes,
-        max_delay_ms=args.max_delay_ms,
-        pipeline_depth=args.pipeline_depth,
-        device_featurize=featurize,
-        param_sharding=True if args.shard_model else None,
-        warmup_example=warmup_example,
-        max_pending=args.max_pending,
-        default_deadline_ms=args.deadline_ms,
-        maintenance_interval_s=args.rebucket_interval,
-        slo_latency_s=(
-            args.slo_latency_ms / 1e3
-            if args.slo_latency_ms is not None else None
-        ),
-        slo_target=args.slo_target,
-        flight_capacity=args.flight_capacity,
-    )
-    gateway.install_signal_handlers()
+            n_model = args.mesh_model or len(jax.devices())
+            mesh_lib.set_mesh(mesh_lib.make_mesh(n_model=n_model))
+        gateway = Gateway(
+            fitted,
+            buckets=tuple(int(b) for b in args.buckets.split(",")),
+            n_lanes=args.lanes,
+            max_delay_ms=args.max_delay_ms,
+            pipeline_depth=args.pipeline_depth,
+            device_featurize=featurize,
+            param_sharding=True if args.shard_model else None,
+            warmup_example=warmup_example,
+            max_pending=args.max_pending,
+            default_deadline_ms=args.deadline_ms,
+            maintenance_interval_s=args.rebucket_interval,
+            slo_latency_s=(
+                args.slo_latency_ms / 1e3
+                if args.slo_latency_ms is not None else None
+            ),
+            slo_target=args.slo_target,
+            flight_capacity=args.flight_capacity,
+        )
+        gateway.install_signal_handlers()
+    else:
+        # zoo mode: SIGTERM/SIGINT drain the whole zoo (every unit
+        # concurrently) instead of one gateway
+        import signal
+
+        def _drain(signum, frame):
+            threading.Thread(
+                target=zoo.close,
+                name="keystone-zoo-drain",
+                daemon=True,
+            ).start()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                signal.signal(sig, _drain)
+            except ValueError:
+                pass  # not the main thread (embedded use)
     # chaos experiments can pre-arm fault points from the environment
     # (KEYSTONE_FAULTS="point=k:v,... ..."); absent env is a no-op.
     # This must run AFTER the Gateway exists: trigger points
@@ -826,6 +1029,7 @@ def main(argv=None) -> int:
         input_dtype=input_dtype,
         request_log=args.request_log,
         chaos_routes=not args.no_chaosz,
+        zoo=zoo,
     ).start()
     # the machine-parseable bound-address line FIRST: with --port 0
     # (ephemeral — no port races) smoke scripts and the fleet drills
@@ -833,14 +1037,24 @@ def main(argv=None) -> int:
     # scraping the human summary below
     print(
         json.dumps(
-            {"listening": server.url().rstrip("/"), "role": "gateway"}
+            {
+                "listening": server.url().rstrip("/"),
+                "role": "gateway",
+                **(
+                    {"models": list(zoo.registry.ids())}
+                    if zoo is not None else {}
+                ),
+            }
         ),
         flush=True,
     )
+    zoo_routes = (
+        "POST /predict/<model>, GET /planz, " if zoo is not None else ""
+    )
     print(
-        f"gateway: {server.url()} (POST /predict, GET /readyz, "
-        "GET /metrics, GET /slz, GET /debugz, GET /profilez, "
-        "POST /swap, POST /drain, GET|POST /chaosz)",
+        f"gateway: {server.url()} (POST /predict, {zoo_routes}"
+        "GET /readyz, GET /metrics, GET /slz, GET /debugz, "
+        "GET /profilez, POST /swap, POST /drain, GET|POST /chaosz)",
         flush=True,
     )
     advertised = args.advertise_url or server.url()
@@ -848,16 +1062,25 @@ def main(argv=None) -> int:
     # outlives the drain must not re-add this replica to the roster
     cancel_registration = threading.Event()
     for router_url in args.register:
-        # background: registration retries must not delay serving
+        # background: registration retries must not delay serving.
+        # Zoo mode advertises the registry's model ids so the router
+        # can route /predict/<model> here.
         threading.Thread(
             target=register_with_router,
             args=(router_url, advertised),
-            kwargs={"cancel": cancel_registration},
+            kwargs={
+                "cancel": cancel_registration,
+                "models": (
+                    list(zoo.registry.ids()) if zoo is not None
+                    else None
+                ),
+            },
             name="keystone-gateway-register",
             daemon=True,
         ).start()
+    plane = zoo if zoo is not None else gateway
     try:
-        while gateway.ready:
+        while plane.ready:
             time.sleep(0.5)
     except KeyboardInterrupt:
         pass
@@ -869,7 +1092,7 @@ def main(argv=None) -> int:
     # 503-closed), the reverse order would drop the roster entry
     # while work is still in flight behind it
     cancel_registration.set()
-    gateway.close()
+    plane.close()
     for router_url in args.register:
         deregister_from_router(router_url, advertised)
     server.stop()

@@ -132,6 +132,17 @@ class Gateway:
                        rebuild lane engines with the same fused stage;
                        ``warmup_example`` must be a RAW example in
                        this mode.
+    aot_store:         the serialized-executable store engine builds
+                       consult: ``"auto"`` (process-configured),
+                       ``None``/``False`` (off), or an explicit
+                       ``AotStore`` — the model zoo passes per-model
+                       NAMESPACED stores here.
+    engine_factory:    optional override, ``callable(buckets) ->
+                       (lane_name -> engine)`` — replaces the
+                       ``fitted.compiled()`` factory for every engine
+                       generation (the zoo's cross-model CSE plane
+                       builds shared-prefix multi-head engines through
+                       this seam).
     max_pending:       admission queue bound.
     default_deadline_ms: deadline applied to requests that don't carry
                        their own.
@@ -171,6 +182,8 @@ class Gateway:
         host_featurize=None,
         device_featurize=None,
         param_sharding=None,
+        aot_store="auto",
+        engine_factory=None,
         max_pending: int = 1024,
         default_deadline_ms: Optional[float] = None,
         maintenance_interval_s: Optional[float] = None,
@@ -201,6 +214,19 @@ class Gateway:
         # model-sharding rules
         self._device_featurize = device_featurize
         self._param_sharding = param_sharding
+        # the AOT executable store every engine generation consults:
+        # "auto" (the process-configured store), None/False (off), or
+        # an explicit AotStore — the model zoo passes each model's
+        # NAMESPACED store here so co-hosted models never share a
+        # cache slot
+        self._aot_store = aot_store
+        # engine-factory override: callable(buckets) -> (lane_name ->
+        # engine). The zoo's cross-model CSE plane builds shared-prefix
+        # multi-head engines this way; when set it fully replaces the
+        # fitted.compiled() factory below (the override owns featurize/
+        # sharding/store wiring) but still rides every generation —
+        # initial build, rebuckets, warm-pool swaps
+        self._engine_factory = engine_factory
         self._rebucket_k = rebucket_k or len(self._buckets)
         self.metrics = GatewayMetrics(registry=registry, gateway=name)
         self.pool = EnginePool(
@@ -293,11 +319,15 @@ class Gateway:
             self._maint.start()
 
     def _factory_for(self, buckets):
+        if self._engine_factory is not None:
+            return self._engine_factory(buckets)
+
         def factory(lane_name: str):
             return self.fitted.compiled(
                 buckets=buckets, name=lane_name,
                 featurize=self._device_featurize,
                 param_sharding=self._param_sharding,
+                aot_store=self._aot_store,
             )
 
         return factory

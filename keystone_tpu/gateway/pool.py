@@ -243,6 +243,18 @@ class EnginePool:
     def healthy_lanes(self) -> int:
         return sum(1 for l in self.lanes if l.healthy)
 
+    def status(self) -> dict:
+        """One inspection snapshot per pool — what ``/planz`` reports
+        as a model's ACTUAL placement (lane count, the lanes' current
+        bucket list, health/load) next to the optimizer's plan."""
+        return {
+            "lanes": len(self.lanes),
+            "healthy_lanes": self.healthy_lanes(),
+            "buckets": list(self.lanes[0].engine.buckets),
+            "free_capacity": self.free_capacity(),
+            "total_load": self.total_load(),
+        }
+
     # -- routing -----------------------------------------------------------
 
     def _pick(self, exclude: Sequence[Lane]) -> Optional[Lane]:

@@ -206,6 +206,31 @@ def reset_device_table() -> None:
         _table = None
 
 
+_ENV_CHIP_HBM = "KEYSTONE_CHIP_HBM_BYTES"
+
+
+def chip_hbm_bytes() -> Optional[int]:
+    """The per-chip parameter budget the zoo placement optimizer plans
+    against: ``$KEYSTONE_CHIP_HBM_BYTES`` when set (CPU CI and hosts
+    whose allocator reports no limit), else the smallest
+    ``hbm_bytes_limit`` the runtime reports across device kinds (a
+    heterogeneous host must plan for its tightest chip). None when
+    neither source knows — callers then skip budget-driven decisions
+    rather than plan against a fabricated number."""
+    env = os.environ.get(_ENV_CHIP_HBM)
+    if env:
+        try:
+            return int(float(env))
+        except ValueError:
+            logger.warning("ignoring unparseable %s=%r",
+                           _ENV_CHIP_HBM, env)
+    limits = [
+        row["hbm_bytes_limit"] for row in device_table()
+        if row.get("hbm_bytes_limit")
+    ]
+    return min(limits) if limits else None
+
+
 def register_device_metrics(registry) -> None:
     """Export the detected table as the standard constant-1 info gauge:
     ``keystone_device_info{kind, platform, count, peak_flops}``.

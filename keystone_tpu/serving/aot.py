@@ -245,6 +245,7 @@ def bucket_key(
     identity: Optional[Dict[str, Any]] = None,
     featurize_token: Optional[str] = None,
     sharding_token: Optional[str] = None,
+    namespace: Optional[str] = None,
 ) -> Tuple[str, Dict[str, Any]]:
     """Fingerprint one bucket program. Returns ``(key, meta)`` where
     ``key`` is the store filename stem and ``meta`` is the full
@@ -264,7 +265,10 @@ def bucket_key(
     params as arguments) and must never share an entry with a
     replicated one — while replicated programs' fingerprints stay
     byte-identical to pre-sharding stores (no fleet-wide cold start on
-    upgrade)."""
+    upgrade). ``namespace`` is the model-zoo partition
+    (``AotStore(namespace=model_id)``): two co-hosted models never
+    share a cache slot even if their content tokens somehow agreed,
+    and the GC accounts each model's bytes separately."""
     meta: Dict[str, Any] = {
         "format": STORE_FORMAT,
         "specs": [
@@ -290,6 +294,12 @@ def bucket_key(
         **(
             {"sharding_token": sharding_token}
             if sharding_token is not None else {}
+        ),
+        # ditto: single-model processes (namespace None) keep their
+        # pre-zoo fingerprints byte-identical
+        **(
+            {"namespace": namespace}
+            if namespace is not None else {}
         ),
         **(identity if identity is not None else runtime_identity()),
     }
@@ -328,8 +338,17 @@ class AotStore:
     # writer's leftover, safe to sweep (a live save lasts seconds)
     STALE_TMP_S = 3600.0
 
-    def __init__(self, root: str, registry=None):
+    def __init__(
+        self, root: str, registry=None, namespace: Optional[str] = None
+    ):
         self.root = os.path.abspath(root)
+        # the model-zoo partition: folded into every bucket_key this
+        # store's engines compute (engine warmup reads it off the
+        # store), so entries from different namespaces coexist in one
+        # root dir but can never be loaded across — the meta re-check
+        # rejects a planted foreign entry before unpickling. None is
+        # the single-model default and keeps pre-zoo keys stable.
+        self.namespace = namespace
         os.makedirs(self.root, mode=0o700, exist_ok=True)
         self._sweep_stale_tmp()
         # plain per-store totals for status()/tests, plus the shared
@@ -365,6 +384,13 @@ class AotStore:
             "stored bucket executable (hits only)",
             buckets=LOAD_SECONDS_BUCKETS,
         )
+        self._bytes_g = reg.gauge(
+            "keystone_aot_store_bytes",
+            "on-disk bytes of AOT store entries, per model-zoo "
+            "namespace ('default' for single-model stores)",
+            ("namespace",),
+        )
+        self._publish_bytes()
 
     # -- store layout ------------------------------------------------------
 
@@ -483,6 +509,7 @@ class AotStore:
             return None
         with self._lock:
             self.saves += 1
+        self._publish_bytes()
         logger.info(
             "aot store: saved bucket %s executable (%d bytes) to %s",
             meta.get("bucket"), len(blob), path,
@@ -554,10 +581,94 @@ class AotStore:
         except Exception:
             return None
 
+    # -- namespace accounting + GC -----------------------------------------
+
+    def _owned_entries(self) -> list:
+        """``(key, size_bytes, mtime)`` for every entry in THIS store's
+        namespace, mtime-ascending (the LRU eviction order). Entries
+        whose JSON preamble is unreadable are claimed by every
+        namespace: they can never be loaded, so any GC may clear them.
+        Meta is read from the preamble only — auditing a store must
+        never unpickle it."""
+        owned = []
+        for key in self.entries():
+            meta = self.read_meta(key)
+            if meta is not None and meta.get("namespace") != self.namespace:
+                continue
+            try:
+                st = os.stat(self.path_for(key))
+            except OSError:
+                continue  # raced a concurrent eviction
+            owned.append((key, int(st.st_size), st.st_mtime))
+        owned.sort(key=lambda e: (e[2], e[0]))
+        return owned
+
+    def namespace_bytes(self) -> int:
+        """On-disk bytes of this namespace's entries — what the
+        ``keystone_aot_store_bytes{namespace}`` gauge exports."""
+        return sum(size for _, size, _ in self._owned_entries())
+
+    def _publish_bytes(self) -> None:
+        try:
+            self._bytes_g.set(
+                float(self.namespace_bytes()),
+                (self.namespace or "default",),
+            )
+        except Exception:
+            # the gauge is observability, not correctness: a raced
+            # listdir/stat must never fail a save or a gc
+            logger.debug("aot store: bytes gauge update failed",
+                         exc_info=True)
+
+    def gc(
+        self, max_bytes: int, pinned: Sequence[str] = ()
+    ) -> Dict[str, Any]:
+        """Evict least-recently-used entries (mtime order — ``save``
+        rewrites touch it, so recently refreshed generations survive)
+        until this NAMESPACE's on-disk bytes fit ``max_bytes``. Entries
+        whose key is in ``pinned`` are never evicted, even if that
+        leaves the namespace over budget (a pinned hot model's programs
+        beat the byte target). Other namespaces' entries are invisible:
+        one model's churn can never GC another model's executables.
+        Best-effort like every store op — an unlinkable entry is
+        counted as an error and skipped, never raised."""
+        report: Dict[str, Any] = {
+            "namespace": self.namespace, "evicted": [],
+            "evicted_bytes": 0,
+        }
+        pinned_set = set(pinned)
+        owned = self._owned_entries()
+        total = sum(size for _, size, _ in owned)
+        for key, size, _ in owned:
+            if total <= max_bytes:
+                break
+            if key in pinned_set:
+                continue
+            try:
+                os.unlink(self.path_for(key))
+            except OSError:
+                self._count("errors")
+                continue
+            total -= size
+            report["evicted"].append(key)
+            report["evicted_bytes"] += size
+        report["kept_bytes"] = total
+        report["over_budget"] = total > max_bytes
+        self._publish_bytes()
+        if report["evicted"]:
+            logger.info(
+                "aot store gc (namespace %s): evicted %d entries "
+                "(%d bytes), %d bytes kept",
+                self.namespace or "default", len(report["evicted"]),
+                report["evicted_bytes"], report["kept_bytes"],
+            )
+        return report
+
     def status(self) -> Dict[str, Any]:
         with self._lock:
             return {
                 "dir": self.root,
+                "namespace": self.namespace,
                 "entries": len(self.entries()),
                 "hits": self.hits,
                 "misses": self.misses,
@@ -599,6 +710,28 @@ def configured_store() -> Optional[AotStore]:
                 )
                 return None
         return _configured
+
+
+def namespaced_store(namespace: str) -> Optional[AotStore]:
+    """A model-zoo view over the process-configured store dir: same
+    root, entries fingerprinted (and GC'd) under ``namespace``. None
+    when no store dir is configured — the zoo then serves without AOT,
+    exactly like a single-model engine would. Not memoized: each model
+    owns its view (per-namespace byte gauges and GC state are
+    per-instance)."""
+    from keystone_tpu.parallel import runtime
+
+    root = runtime.aot_cache_dir()
+    if root is None:
+        return None
+    try:
+        return AotStore(root, namespace=str(namespace))
+    except Exception:
+        logger.info(
+            "aot store at %s unavailable for namespace %s; serving "
+            "without it", root, namespace, exc_info=True,
+        )
+        return None
 
 
 def status() -> Dict[str, Any]:

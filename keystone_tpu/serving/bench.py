@@ -135,10 +135,15 @@ class _Affine(Transformer):
         return jnp.tanh(x @ self.W + self.b)
 
 
-def build_pipeline(d: int = 256, hidden: int = 512, depth: int = 4):
+def build_pipeline(
+    d: int = 256, hidden: int = 512, depth: int = 4, seed: int = 0
+):
     """An estimator-free array-mode chain -> FittedPipeline (depth
-    matmul nodes: a realistic compile cost for the cold/warm row)."""
-    rng = np.random.default_rng(0)
+    matmul nodes: a realistic compile cost for the cold/warm row).
+    ``seed`` varies the weights — the zoo spec loader uses it so two
+    same-shaped models carry distinct params (and therefore distinct
+    AOT model tokens)."""
+    rng = np.random.default_rng(seed)
     dims = [d] + [hidden] * (depth - 1) + [d]
     pipe = None
     for i in range(depth):
@@ -1110,6 +1115,249 @@ def bench_flagship_featurize(
             "mfu": round(mfu, 8) if mfu is not None else None,
             "roofline": roofline,
             "peaks_known": peaks_known,
+        },
+    )
+
+
+def bench_zoo(
+    emit,
+    img: int = 34,
+    hidden: int = 128,
+    depth: int = 2,
+    buckets: Sequence[int] = (4, 16),
+    n_requests: int = 96,
+    n_threads: int = 8,
+    n_check: int = 12,
+    min_speedup: float = 1.5,
+) -> None:
+    """``serving_zoo`` — the cross-model featurize CSE A/B: TWO models
+    sharing the paper's flagship SIFT+LCS→FV featurize prefix
+    (``build_flagship_featurize_pipeline``) with different heads,
+    served two ways at equal device count —
+
+    - **baseline**: two independent gateways (the two-process proxy:
+      each owns its lanes and fused engine, so every request pays the
+      shared featurize prefix TWICE, once per model);
+    - **zoo**: one ``ModelZoo`` whose CSE grouping
+      (``zoo.featurize_groups``) co-hosts both heads behind ONE
+      ``SharedPrefixEngine`` — the prefix runs once per coalesced
+      window and the featurized activations fan out to each head
+      inside the same fused program.
+
+    Every request is an ensemble fan-out (one example → both models'
+    predictions), so examples/sec counts ensemble examples on both
+    sides. Asserted (raises, not asserts): per-model zoo outputs
+    allclose to the solo baselines (rtol=1e-4/atol=1e-5, the repo's
+    fusion tolerance); the shared prefix is compiled ONCE per bucket
+    (zoo compiles == len(buckets) vs the baseline's 2x — both sides
+    run with the AOT store detached so the trace counters are the
+    fact, not a cache artifact); the zoo side issues strictly fewer
+    device dispatches for the same request stream (one window serves
+    both heads); and sustained zoo ex/s >= ``min_speedup`` x the
+    baseline, with one bounded re-measure of BOTH sides absorbing
+    scheduler jitter before the row may fail."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.gateway import Gateway
+    from keystone_tpu.serving.featurize import (
+        build_flagship_featurize_pipeline,
+    )
+    from keystone_tpu.zoo import (
+        BuiltModel, ModelRegistry, ModelSpec, ModelZoo,
+    )
+
+    featurize, feat_d = build_flagship_featurize_pipeline(img=img)
+    heads = {
+        mid: build_pipeline(
+            d=feat_d, hidden=hidden, depth=depth, seed=seed
+        )
+        for mid, seed in (("alpha", 1), ("beta", 2))
+    }
+    model_ids = tuple(heads)
+    rng = np.random.default_rng(17)
+    check = rng.integers(
+        0, 256, (n_check, img, img, 3), dtype=np.uint8
+    )
+    raws = rng.integers(
+        0, 256, (n_requests, img, img, 3), dtype=np.uint8
+    )
+    warm = jnp.zeros((img, img, 3), jnp.uint8)
+
+    def drive(submit, inputs, label):
+        served = [None] * len(inputs)
+        errors = []
+
+        def client(tid):
+            # a shed/timeout must FAIL the row, not silently kill the
+            # thread — a dead client shrinks dt and overstates the rate
+            try:
+                for i in range(tid, len(inputs), n_threads):
+                    served[i] = submit(inputs[i])
+            except Exception as e:
+                errors.append(e)
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=client, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(
+                f"zoo bench client failed on {label}: {errors[0]!r}"
+            ) from errors[0]
+        return time.perf_counter() - t0, served
+
+    def measure(submit, label):
+        # unmeasured warm half-pass, then best-of-2 sustained passes
+        drive(submit, list(raws[: n_requests // 2]), label)
+        dt = float("inf")
+        for _ in range(2):
+            dt = min(dt, drive(submit, list(raws), label)[0])
+        return n_requests / dt
+
+    def totals(gateways):
+        compiles = dispatches = 0
+        for gw in gateways:
+            for lane in gw.pool.lanes:
+                m = lane.engine.metrics
+                compiles += m.compiles.total
+                dispatches += m.dispatches.total
+        return compiles, dispatches
+
+    # baseline: two independent single-model gateways. AOT detached on
+    # BOTH sides so the compile counters measure tracing, not cache
+    # hits (the shared engine refuses AOT by construction; the solos
+    # must match that footing for the 2x-compiles claim to be honest).
+    solo = {
+        mid: Gateway(
+            head, buckets=buckets, n_lanes=1, max_delay_ms=2.0,
+            device_featurize=featurize, warmup_example=warm,
+            aot_store=None, name=f"bench-zoo-solo-{mid}",
+        )
+        for mid, head in heads.items()
+    }
+
+    reg = ModelRegistry()
+    for mid, head in heads.items():
+        reg.register(ModelSpec(
+            model_id=mid,
+            build=(lambda h=head: BuiltModel(
+                fitted=h, featurize=featurize
+            )),
+            buckets=buckets,
+            lanes=1,
+            input_dtype=np.uint8,
+            warmup_example=warm,
+            max_delay_ms=2.0,
+            default=(mid == model_ids[0]),
+        ))
+    zoo = ModelZoo(reg, cse=True)
+
+    def base_submit(x):
+        futs = {m: solo[m].predict(x) for m in model_ids}
+        return {
+            m: np.asarray(f.result(timeout=120))
+            for m, f in futs.items()
+        }
+
+    def zoo_submit(x):
+        out = zoo.predict_many(x, model_ids).result(timeout=120)
+        return {m: np.asarray(out[m]) for m in model_ids}
+
+    try:
+        hosted = zoo.host()
+        if not any(len(unit) == 2 for unit in hosted):
+            raise RuntimeError(
+                f"zoo did not CSE-group the two flagship heads "
+                f"(hosted units: {hosted}) — identical featurize "
+                "tokens must co-host behind one SharedPrefixEngine"
+            )
+        base_outs = drive(base_submit, list(check), "baseline")[1]
+        zoo_outs = drive(zoo_submit, list(check), "zoo")[1]
+        base_rate = measure(base_submit, "baseline")
+        zoo_rate = measure(zoo_submit, "zoo")
+        for _ in range(3):
+            if zoo_rate >= min_speedup * base_rate:
+                break
+            # bounded re-measures of BOTH sides (scheduler jitter on
+            # a loaded CI host is large relative to one pass); best
+            # of all observed passes per side, then the gate is final
+            base_rate = max(
+                base_rate, measure(base_submit, "baseline")
+            )
+            zoo_rate = max(zoo_rate, measure(zoo_submit, "zoo"))
+        base_compiles, base_dispatches = totals(solo.values())
+        zoo_compiles, zoo_dispatches = totals(
+            [zoo.gateway_for(model_ids[0])]
+        )
+    finally:
+        zoo.close()
+        for gw in solo.values():
+            gw.close()
+
+    maxdiff = 0.0
+    for i, (b, z) in enumerate(zip(base_outs, zoo_outs)):
+        for mid in model_ids:
+            maxdiff = max(
+                maxdiff, float(np.abs(b[mid] - z[mid]).max())
+            )
+            if not np.allclose(b[mid], z[mid], rtol=1e-4, atol=1e-5):
+                raise RuntimeError(
+                    f"zoo output for model {mid!r} diverges from its "
+                    f"solo gateway on example {i} (max abs diff "
+                    f"{np.abs(b[mid] - z[mid]).max():.3e}) — the "
+                    "shared prefix must not change any head's answer"
+                )
+    if zoo_compiles > len(buckets):
+        raise RuntimeError(
+            f"zoo side traced {zoo_compiles} programs for "
+            f"{len(buckets)} buckets — the shared prefix was supposed "
+            "to compile ONCE per bucket for the whole group"
+        )
+    if base_compiles < 2 * zoo_compiles:
+        raise RuntimeError(
+            f"baseline traced {base_compiles} programs vs the zoo's "
+            f"{zoo_compiles} — the two-gateway baseline must pay the "
+            "featurize prefix per model for this A/B to mean anything"
+        )
+    if base_dispatches <= zoo_dispatches:
+        raise RuntimeError(
+            f"zoo issued {zoo_dispatches} device dispatches vs the "
+            f"baseline's {base_dispatches} for the same request "
+            "stream — one coalesced window must serve BOTH heads"
+        )
+    if zoo_rate < min_speedup * base_rate:
+        raise RuntimeError(
+            f"zoo sustains {zoo_rate:.1f} ensemble ex/s vs the "
+            f"two-gateway baseline's {base_rate:.1f} — only "
+            f"{zoo_rate / base_rate:.2f}x (need >= {min_speedup}x): "
+            "sharing the featurize prefix did not pay for itself"
+        )
+    emit(
+        "serving_zoo",
+        zoo_rate, "examples/sec",
+        extra={
+            "baseline_examples_per_sec": round(base_rate, 1),
+            "zoo_examples_per_sec": round(zoo_rate, 1),
+            "speedup_vs_two_gateways": round(zoo_rate / base_rate, 3),
+            "min_speedup": min_speedup,
+            "models": list(model_ids),
+            "cse_groups": [list(u) for u in hosted],
+            "baseline_compiles": base_compiles,
+            "zoo_compiles": zoo_compiles,
+            "baseline_dispatches": base_dispatches,
+            "zoo_dispatches": zoo_dispatches,
+            "raw_shape": [img, img, 3],
+            "feature_dim": feat_d,
+            "buckets": list(buckets),
+            "requests": n_requests,
+            "client_threads": n_threads,
+            "outputs_allclose": True,
+            "max_abs_diff": maxdiff,
         },
     )
 
@@ -2390,6 +2638,15 @@ def run_featurize_benches(emit) -> None:
     bench_flagship_featurize(emit)
 
 
+def run_zoo_benches(emit) -> None:
+    """The model-zoo CSE row alone (``--zoo-only``, what
+    ``bin/smoke-zoo.sh`` invokes): two flagship-featurize models
+    served through one ModelZoo vs two independent gateways. Owns its
+    pipeline shape — the shared prefix IS the measurement, so it
+    doesn't inherit the generic bench dims."""
+    bench_zoo(emit)
+
+
 def run_shard_benches(emit) -> None:
     """The model-axis A/B alone (``--shard-only``, what
     ``bin/smoke-shard.sh`` invokes; ~60 s of gateway warmups across
@@ -2411,6 +2668,7 @@ def run_serving_benches(
     autoscale: bool = False,
     featurize: bool = False,
     shard: bool = False,
+    zoo: bool = False,
 ) -> None:
     fitted = build_pipeline(d, hidden, depth)
     bench_cold_vs_warm(emit, fitted, buckets, d)
@@ -2455,6 +2713,8 @@ def run_serving_benches(
         run_featurize_benches(emit)
     if shard:
         run_shard_benches(emit)
+    if zoo:
+        run_zoo_benches(emit)
     if autoscale:
         # its own (smaller) pipeline: scale-up reaction time includes
         # per-replica warmup, which the default bench shape would
@@ -2547,6 +2807,17 @@ def main(argv=None) -> int:
     ap.add_argument("--featurize-only", action="store_true",
                     help="run ONLY the device-side featurization row "
                     "(what bin/smoke-featurize.sh invokes)")
+    ap.add_argument("--zoo", action="store_true",
+                    help="also run the model-zoo CSE row "
+                    "(serving_zoo): two models sharing the flagship "
+                    "featurize prefix served through one ModelZoo "
+                    "(SharedPrefixEngine) vs two independent "
+                    "gateways, asserting per-model output parity, "
+                    "prefix compiled once per bucket, fewer device "
+                    "dispatches, and >= 1.5x ensemble ex/s (~60s)")
+    ap.add_argument("--zoo-only", action="store_true",
+                    help="run ONLY the model-zoo CSE row (what "
+                    "bin/smoke-zoo.sh invokes)")
     ap.add_argument("--shard", action="store_true",
                     help="also run the model-axis A/B "
                     "(serving_sharded_vs_replicated): the same model "
@@ -2603,6 +2874,8 @@ def main(argv=None) -> int:
             run_shard_benches(emit)
         elif args.featurize_only:
             run_featurize_benches(emit)
+        elif args.zoo_only:
+            run_zoo_benches(emit)
         elif args.autoscale_only:
             run_autoscale_benches(emit)
         elif args.fleet_only:
@@ -2624,6 +2897,7 @@ def main(argv=None) -> int:
                 autoscale=args.autoscale,
                 featurize=args.featurize,
                 shard=args.shard,
+                zoo=args.zoo,
             )
 
     if args.profile_dir:

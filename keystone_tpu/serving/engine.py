@@ -649,6 +649,10 @@ class CompiledPipeline:
                     model_token=token, identity=identity,
                     featurize_token=feat_token,
                     sharding_token=shard_token,
+                    # a namespaced store (the model zoo's per-model
+                    # view) partitions its entries; plain stores keep
+                    # their pre-zoo fingerprints byte-identical
+                    namespace=getattr(store, "namespace", None),
                 )
                 # the zero-cold-start path: install the serialized
                 # executable BEFORE any trace of this bucket can

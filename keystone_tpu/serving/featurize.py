@@ -269,8 +269,22 @@ def build_flagship_featurize_pipeline(
     return fitted, feat_dim
 
 
+def featurize_token(fitted) -> str:
+    """Content digest of a fitted featurize chain — the zoo's CSE
+    grouping key (``zoo/cse.py``). Alias of ``aot.pipeline_token``:
+    two chains share a prefix iff the SAME fingerprint that partitions
+    the AOT store says they compute the same function (operator
+    classes + wiring + every parameter array), so "identical
+    featurize_token" carries the same never-serve-the-wrong-model
+    guarantee in both subsystems."""
+    from keystone_tpu.serving.aot import pipeline_token
+
+    return pipeline_token(fitted)
+
+
 __all__ = [
     "build_featurize_pipeline",
     "build_flagship_featurize_pipeline",
+    "featurize_token",
     "flagship_pipeline",
 ]

@@ -1,0 +1,12 @@
+import os
+import sys
+
+# the shared tiny-pipeline helpers live next to the gateway suite;
+# rootdir conftest only puts tests/ itself on the path
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "gateway",
+    ),
+)

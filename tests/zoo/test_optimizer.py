@@ -1,0 +1,118 @@
+"""The placement optimizer is PURE: no jax, no device, no clock.
+Same profiles + same budget -> byte-identical plan; bucket choice
+defers to ``suggest_buckets``'s exact DP; the replicated-vs-sharded
+decision prices ``params_nbytes`` against the per-chip HBM budget;
+spare lanes split by demand with a deterministic largest-remainder."""
+
+import pytest
+
+from keystone_tpu.serving.autoscale import suggest_buckets
+from keystone_tpu.zoo import (
+    ChipBudget,
+    ModelProfile,
+    plan_placement,
+)
+
+HIST = {1: 500, 4: 120, 16: 40, 64: 5}
+
+
+def test_plan_is_deterministic_and_order_insensitive():
+    profiles = [
+        ModelProfile("beta", histogram=HIST, params_nbytes=1000),
+        ModelProfile("alpha", histogram={2: 50}, params_nbytes=2000),
+    ]
+    budget = ChipBudget(hbm_bytes=10**9, n_chips=2, lane_budget=5)
+    a = plan_placement(profiles, budget).to_dict()
+    b = plan_placement(list(reversed(profiles)), budget).to_dict()
+    assert a == b
+    assert [p["model"] for p in a["placements"]] == ["alpha", "beta"]
+
+
+def test_buckets_from_histogram_match_the_dp():
+    prof = ModelProfile(
+        "m", histogram=HIST, fallback_buckets=(8, 32, 128)
+    )
+    plan = plan_placement([prof], ChipBudget())
+    placement = plan.placement_for("m")
+    assert placement.buckets == suggest_buckets(
+        HIST, 3, max_bucket=128
+    )
+    assert placement.predicted_efficiency is not None
+    assert 0.0 < placement.predicted_efficiency <= 1.0
+
+
+def test_cold_model_uses_fallback_buckets_verbatim():
+    prof = ModelProfile("cold", fallback_buckets=(4, 16))
+    placement = plan_placement([prof], ChipBudget()).placement_for(
+        "cold"
+    )
+    assert placement.buckets == (4, 16)
+    assert placement.predicted_efficiency is None
+
+
+def test_sharding_decision_prices_params_against_hbm():
+    big = ModelProfile("big", params_nbytes=900)
+    small = ModelProfile("small", params_nbytes=100)
+    # param budget = 1000 * 0.8 = 800 < big's 900
+    budget = ChipBudget(hbm_bytes=1000, n_chips=4)
+    plan = plan_placement([big, small], budget)
+    assert plan.placement_for("big").sharded is True
+    # a sharded model gets exactly ONE lane: extra lanes would
+    # multiply HBM (each lane holds a param copy), not throughput
+    assert plan.placement_for("big").lanes == 1
+    assert plan.placement_for("small").sharded is False
+    assert "mesh-sharded" in plan.placement_for("big").reason
+
+
+def test_over_budget_without_chips_stays_replicated():
+    big = ModelProfile("big", params_nbytes=900)
+    plan = plan_placement([big], ChipBudget(hbm_bytes=1000, n_chips=1))
+    assert plan.placement_for("big").sharded is False
+    assert "no model axis" in plan.placement_for("big").reason
+
+
+def test_no_hbm_budget_disables_the_decision():
+    big = ModelProfile("big", params_nbytes=10**15)
+    plan = plan_placement([big], ChipBudget(hbm_bytes=None, n_chips=8))
+    assert plan.placement_for("big").sharded is False
+
+
+def test_lane_split_proportional_with_floor_one():
+    hot = ModelProfile("hot", histogram={8: 900})
+    warm = ModelProfile("warm", histogram={8: 90})
+    cold = ModelProfile("cold", histogram={8: 10})
+    plan = plan_placement(
+        [hot, warm, cold], ChipBudget(lane_budget=10)
+    )
+    lanes = {
+        p.model_id: p.lanes for p in plan.placements
+    }
+    assert sum(lanes.values()) == 10
+    assert lanes["cold"] >= 1
+    assert lanes["hot"] > lanes["warm"] >= lanes["cold"]
+    shares = {
+        p.model_id: p.demand_share for p in plan.placements
+    }
+    assert sum(shares.values()) == pytest.approx(1.0)
+
+
+def test_lane_split_tie_breaks_by_id():
+    a = ModelProfile("a", histogram={4: 100})
+    b = ModelProfile("b", histogram={4: 100})
+    # 3 lanes over two equal demands: floor 1 each, the one spare
+    # lane's remainders tie -> lexicographically first id wins
+    plan = plan_placement([a, b], ChipBudget(lane_budget=3))
+    assert plan.placement_for("a").lanes == 2
+    assert plan.placement_for("b").lanes == 1
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError, match="duplicate"):
+        plan_placement(
+            [ModelProfile("m"), ModelProfile("m")], ChipBudget()
+        )
+    with pytest.raises(ValueError, match="lane budget"):
+        plan_placement(
+            [ModelProfile("a"), ModelProfile("b")],
+            ChipBudget(lane_budget=1),
+        )
