@@ -184,6 +184,12 @@ def main(argv=None) -> int:
               "plan artifact serve-autoscale --plan loads — the "
               "policy thresholds are measured, not guessed; "
               "keystone_tpu/autoscale/planner.py)")
+        print("  serve-lifecycle  (operator controls for a gateway's "
+              "online model lifecycle — status/tick/rollback against "
+              "a serve-gateway --refit frontend's /lifecyclez: "
+              "streaming refit from POST /feedback, shadow-mirrored "
+              "candidates, deterministic canary fractions, atomic "
+              "promote with auto-rollback; keystone_tpu/lifecycle/)")
         print("  serve-aot-build  (pre-populate the AOT serialized-"
               "executable store: compile every bucket once and "
               "serialize the executables so a brand-new host's "
@@ -264,6 +270,11 @@ def main(argv=None) -> int:
         from keystone_tpu.autoscale.planner import main as capacity_plan_main
 
         return capacity_plan_main(argv[1:])
+    if app == "serve-lifecycle":
+        # stdlib-only HTTP client: no jax import for operator controls
+        from keystone_tpu.lifecycle.cli import main as lifecycle_main
+
+        return lifecycle_main(argv[1:])
     if app == "serve-aot-build":
         from keystone_tpu.serving.aot import build_main
 

@@ -154,6 +154,16 @@ class _Handler(JsonHandler):
         return self.server.zoo  # type: ignore[attr-defined]
 
     @property
+    def lifecycle(self):
+        """The LifecycleManager, if this frontend runs one — set
+        directly (``--refit``) or attached to the zoo
+        (``ModelZoo.attach_lifecycle``)."""
+        mgr = self.server.lifecycle  # type: ignore[attr-defined]
+        if mgr is None and self.zoo is not None:
+            mgr = getattr(self.zoo, "lifecycle", None)
+        return mgr
+
+    @property
     def gateway(self) -> Gateway:
         gw = self.server.gateway  # type: ignore[attr-defined]
         if gw is None:
@@ -257,6 +267,16 @@ class _Handler(JsonHandler):
                     self._send_json(
                         faults.get_injector().status(), indent=1
                     )
+            elif path == "/lifecyclez":
+                if self.lifecycle is None:
+                    self._send_error_json(
+                        404, "no_lifecycle",
+                        detail="started without --refit; /lifecyclez "
+                               "reports the online-lifecycle state "
+                               "machine per model",
+                    )
+                else:
+                    self._send_json(self.lifecycle.status(), indent=1)
             elif path == "/tracez":
                 from keystone_tpu.observability.tracing import (
                     tracez_document,
@@ -276,7 +296,7 @@ class _Handler(JsonHandler):
                     404,
                     "not found; try /predict /predict/<model> /planz "
                     "/readyz /healthz /metrics /slz /debugz /tracez "
-                    "/profilez /chaosz\n",
+                    "/profilez /chaosz /lifecyclez\n",
                 )
         except Exception as e:
             logger.exception("gateway GET error for %s", self.path)
@@ -347,6 +367,13 @@ class _Handler(JsonHandler):
                 self._predict(model_id or None)
             elif path == "/chaosz":
                 self._chaosz()
+            elif path == "/feedback" or path.startswith("/feedback/"):
+                model_id = path[len("/feedback/"):] if (
+                    path.startswith("/feedback/")
+                ) else None
+                self._feedback(model_id or None)
+            elif path == "/lifecyclez":
+                self._lifecyclez_post()
             elif path == "/swap":
                 if self.zoo is not None:
                     self._send_json(
@@ -375,7 +402,7 @@ class _Handler(JsonHandler):
                 self._send_text(
                     404,
                     "not found; try /predict /predict/<model> /swap "
-                    "/drain /chaosz\n",
+                    "/drain /chaosz /feedback /lifecyclez\n",
                 )
         except Overloaded as e:
             code = _status_for(e)
@@ -451,6 +478,82 @@ class _Handler(JsonHandler):
             )
             return
         self._send_json(injector.status(), indent=1)
+
+    def _feedback(self, model_id: Optional[str] = None) -> None:
+        """Queue one labeled batch for the streaming refit. Body:
+        ``{"instances": [[...], ...], "labels": [[...], ...]}``. The
+        accumulation itself happens at policy-tick time, off this
+        request path — the handler only validates shapes and appends
+        to the controller's buffer."""
+        mgr = self.lifecycle
+        if mgr is None:
+            self._send_error_json(
+                404, "no_lifecycle",
+                detail="started without --refit; /feedback feeds the "
+                       "streaming-refit accumulator",
+            )
+            return
+        controller = mgr.get(model_id)
+        if controller is None:
+            self._send_error_json(
+                404, "unknown_lifecycle_model", model=model_id,
+                known=mgr.models(),
+            )
+            return
+        try:
+            doc = json.loads(self._read_body() or b"{}")
+            instances = doc["instances"]
+            labels = doc["labels"]
+        except (ValueError, KeyError, TypeError) as e:
+            self._send_error_json(
+                400, "bad_request",
+                detail='want {"instances": [[...]], "labels": '
+                       f'[[...]]}} ({e})',
+            )
+            return
+        try:
+            n = controller.add_feedback(instances, labels)
+        except (ValueError, RuntimeError) as e:
+            self._send_error_json(400, "bad_request", detail=str(e))
+            return
+        self._send_json({"queued": n, "model": controller.name})
+
+    def _lifecyclez_post(self) -> None:
+        """Operator controls (``serve-lifecycle``): ``{"tick": true}``
+        forces one policy tick on every controller; ``{"rollback":
+        true[, "model": m]}`` forces a rollback on one controller."""
+        mgr = self.lifecycle
+        if mgr is None:
+            self._send_error_json(
+                404, "no_lifecycle",
+                detail="started without --refit",
+            )
+            return
+        try:
+            doc = json.loads(self._read_body() or b"{}")
+        except ValueError as e:
+            self._send_error_json(400, "bad_request", detail=str(e))
+            return
+        if doc.get("tick"):
+            self._send_json({"ticked": mgr.tick_all()}, indent=1)
+        elif doc.get("rollback"):
+            controller = mgr.get(doc.get("model"))
+            if controller is None:
+                self._send_error_json(
+                    404, "unknown_lifecycle_model",
+                    model=doc.get("model"), known=mgr.models(),
+                )
+                return
+            self._send_json(
+                {"rolled_back": controller.force_rollback("manual")},
+                indent=1,
+            )
+        else:
+            self._send_error_json(
+                400, "bad_request",
+                detail='want {"tick": true} or {"rollback": true'
+                       '[, "model": m]}',
+            )
 
     def _predict(self, model_id: Optional[str] = None) -> None:
         # W3C trace adoption FIRST, before the body can 400 or
@@ -615,6 +718,7 @@ class GatewayServer(BackgroundServer, device_obs.MemorySamplerHost):
         request_log: Any = False,
         chaos_routes: bool = True,
         zoo=None,
+        lifecycle=None,
     ):
         """``request_log``: falsy = off; True = one JSON line per
         /predict instance on stdout; a path string = append the lines
@@ -627,7 +731,12 @@ class GatewayServer(BackgroundServer, device_obs.MemorySamplerHost):
         ``gateway``: /predict/<model> routes by id, bare /predict
         serves the default model with ITS input dtype (the
         ``input_dtype`` arg only applies to single-gateway mode), and
-        /planz reports plan-vs-actual."""
+        /planz reports plan-vs-actual. ``lifecycle`` (a
+        ``LifecycleManager``) turns on the online-lifecycle surface:
+        ``POST /feedback[/<model>]`` queues labeled examples for the
+        streaming refit, ``GET /lifecyclez`` reports every model's
+        refit→shadow→canary state, ``POST /lifecyclez`` forces a
+        policy tick or a rollback (``serve-lifecycle``)."""
         if (gateway is None) == (zoo is None):
             raise ValueError(
                 "GatewayServer wants exactly one of gateway= or zoo="
@@ -635,6 +744,7 @@ class GatewayServer(BackgroundServer, device_obs.MemorySamplerHost):
         super().__init__(port=port, host=host)
         self.gateway = gateway
         self.zoo = zoo
+        self.lifecycle = lifecycle
         self.registry = (
             registry if registry is not None else get_global_registry()
         )
@@ -654,6 +764,7 @@ class GatewayServer(BackgroundServer, device_obs.MemorySamplerHost):
     def _configure(self, httpd) -> None:
         httpd.gateway = self.gateway
         httpd.zoo = self.zoo
+        httpd.lifecycle = self.lifecycle
         httpd.registry = self.registry
         httpd.input_dtype = self.input_dtype
         httpd.request_log = self.request_log
@@ -839,6 +950,31 @@ def main(argv=None) -> int:
                     "least-recently-used unpinned model is evicted "
                     "(drains in the background) and pages back in on "
                     "its next request (default: all models resident)")
+    ap.add_argument("--refit", action="store_true",
+                    help="run the ONLINE MODEL LIFECYCLE over the "
+                    "demo model: POST /feedback streams labeled "
+                    "examples into an incremental normal-equations "
+                    "refit of the model's head; each solved candidate "
+                    "walks shadow -> canary -> promoted (atomic "
+                    "engine swap) or auto-rolls back on the accuracy/"
+                    "SLO gates. GET /lifecyclez reports the state "
+                    "machine; serve-lifecycle drives it remotely. "
+                    "Single-model mode only (not --zoo/"
+                    "--device-featurize)")
+    ap.add_argument("--refit-interval-s", type=float, default=2.0,
+                    metavar="S",
+                    help="with --refit: background policy-tick "
+                    "period; 0 disables the thread (tick via POST "
+                    "/lifecyclez, e.g. serve-lifecycle tick)")
+    ap.add_argument("--refit-min-samples", type=int, default=256,
+                    metavar="N",
+                    help="with --refit: fresh feedback rows required "
+                    "before a new candidate is solved")
+    ap.add_argument("--canary-fraction", type=float, default=0.25,
+                    metavar="F",
+                    help="with --refit: deterministic fraction of "
+                    "live requests the canary stage routes to the "
+                    "candidate")
     ap.add_argument("--d", type=int, default=256)
     ap.add_argument("--hidden", type=int, default=512)
     ap.add_argument("--depth", type=int, default=4)
@@ -903,10 +1039,18 @@ def main(argv=None) -> int:
 
         enable_tracing()
 
+    if args.refit and (args.zoo or args.device_featurize):
+        print(
+            "--refit wants the plain demo model (not --zoo / "
+            "--device-featurize)",
+            flush=True,
+        )
+        return 2
     featurize = None
     input_dtype = np.float32
     zoo = None
     gateway = None
+    refit_base = refit_head = None
     if args.zoo:
         from keystone_tpu.zoo import ModelZoo, load_zoo_spec
 
@@ -965,9 +1109,25 @@ def main(argv=None) -> int:
         warmup_example = jnp.zeros((args.img, args.img, 3), jnp.uint8)
         input_dtype = np.uint8
     if zoo is None:
-        fitted = build_pipeline(
-            d=args.d, hidden=args.hidden, depth=args.depth
-        )
+        if args.refit:
+            # the SAME model build_pipeline serves (identical rng
+            # draws → bitwise-equal outputs), split at the last layer
+            # so the lifecycle can refit the head in closed form and
+            # rebuild candidates as base.and_then(affine_head(W, b))
+            from keystone_tpu.serving.bench import (
+                affine_head,
+                build_split_pipeline,
+            )
+
+            refit_base, head_w, head_b = build_split_pipeline(
+                d=args.d, hidden=args.hidden, depth=args.depth
+            )
+            refit_head = affine_head
+            fitted = refit_base.and_then(affine_head(head_w, head_b))
+        else:
+            fitted = build_pipeline(
+                d=args.d, hidden=args.hidden, depth=args.depth
+            )
         if not args.device_featurize:
             warmup_example = jnp.zeros((args.d,), jnp.float32)
         if args.shard_model:
@@ -1024,12 +1184,36 @@ def main(argv=None) -> int:
     # registered for them, so arming before construction would be a
     # silent no-op.
     faults.arm_from_env()
+    lifecycle = None
+    if args.refit:
+        from keystone_tpu.lifecycle import LifecycleManager
+        from keystone_tpu.lifecycle.controller import (
+            LifecycleController,
+        )
+
+        lifecycle = LifecycleManager()
+        lifecycle.add(
+            LifecycleController(
+                gateway,
+                base=refit_base,
+                head_builder=refit_head,
+                feature_dim=args.hidden,
+                out_dim=args.d,
+                name="default",
+                canary_fraction=args.canary_fraction,
+                min_refit_samples=args.refit_min_samples,
+                interval_s=args.refit_interval_s or None,
+                aot_namespace="lifecycle/default",
+            ),
+            default=True,
+        )
     server = GatewayServer(
         gateway, port=args.port, host=args.host,
         input_dtype=input_dtype,
         request_log=args.request_log,
         chaos_routes=not args.no_chaosz,
         zoo=zoo,
+        lifecycle=lifecycle,
     ).start()
     # the machine-parseable bound-address line FIRST: with --port 0
     # (ephemeral — no port races) smoke scripts and the fleet drills
@@ -1051,8 +1235,13 @@ def main(argv=None) -> int:
     zoo_routes = (
         "POST /predict/<model>, GET /planz, " if zoo is not None else ""
     )
+    lifecycle_routes = (
+        "POST /feedback, GET|POST /lifecyclez, "
+        if lifecycle is not None else ""
+    )
     print(
         f"gateway: {server.url()} (POST /predict, {zoo_routes}"
+        f"{lifecycle_routes}"
         "GET /readyz, GET /metrics, GET /slz, GET /debugz, "
         "GET /profilez, POST /swap, POST /drain, GET|POST /chaosz)",
         flush=True,
@@ -1092,6 +1281,10 @@ def main(argv=None) -> int:
     # 503-closed), the reverse order would drop the roster entry
     # while work is still in flight behind it
     cancel_registration.set()
+    if lifecycle is not None:
+        # stop the refit/tick plane BEFORE draining the gateway:
+        # a tick mid-drain would race swap_model against close()
+        lifecycle.close()
     plane.close()
     for router_url in args.register:
         deregister_from_router(router_url, advertised)

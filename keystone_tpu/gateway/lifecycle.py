@@ -54,6 +54,7 @@ from keystone_tpu.gateway.pool import EnginePool
 from keystone_tpu.loadgen import faults
 from keystone_tpu.observability.flight import FlightRecorder
 from keystone_tpu.observability.slo import Slo, SloMonitor
+from keystone_tpu.serving.batching import MicroBatcher
 from keystone_tpu.serving.autoscale import (
     predicted_efficiency,
     suggest_buckets,
@@ -70,6 +71,10 @@ MIN_REBUCKET_OBSERVATIONS = 64
 # holds >= SHED_BURN for SUSTAIN consecutive samples; relax once it
 # falls back under 1.0 (budget no longer being consumed too fast)
 SLO_SHED_BURN = 4.0
+
+# swap_model's "keep the current AOT store" default — None is a real
+# value there (it means: candidate engines get no store at all)
+_UNCHANGED = object()
 SLO_SUSTAIN_SAMPLES = 2
 SLO_PRESSURE = 0.75
 
@@ -214,6 +219,12 @@ class Gateway:
         # model-sharding rules
         self._device_featurize = device_featurize
         self._param_sharding = param_sharding
+        # kept for build_model_batcher: a candidate engine's batcher
+        # must match the lanes' windowing/featurize config or the
+        # shadow diff would measure batching, not the model
+        self._max_delay_ms = max_delay_ms
+        self._pipeline_depth = pipeline_depth
+        self._host_featurize = host_featurize
         # the AOT executable store every engine generation consults:
         # "auto" (the process-configured store), None/False (off), or
         # an explicit AotStore — the model zoo passes each model's
@@ -519,6 +530,70 @@ class Gateway:
             self._factory_for(buckets),
             warmup_example=self._warmup_example,
         )
+
+    def build_model_batcher(
+        self, fitted, *, name: str, aot_store=None
+    ) -> MicroBatcher:
+        """One engine + micro-batcher for a DIFFERENT fitted pipeline
+        over THIS gateway's serving config (buckets, device featurize,
+        sharding, windowing) — the candidate plane the lifecycle loop
+        points shadow and canary traffic at. Deliberately NOT a pool
+        lane: the candidate serves copies/fractions, never owns
+        routing, and is closed by its controller. ``aot_store`` is the
+        candidate's own (typically per-version namespaced) store;
+        None means no store — a candidate must never populate the
+        incumbent's cache slots."""
+        if self._engine_factory is not None:
+            raise RuntimeError(
+                f"gateway {self.name} runs on an engine-factory "
+                "override (zoo CSE plane); its engines aren't "
+                "buildable from a fitted pipeline"
+            )
+        engine = fitted.compiled(
+            buckets=self._buckets,
+            name=name,
+            featurize=self._device_featurize,
+            param_sharding=self._param_sharding,
+            aot_store=aot_store if aot_store is not None else False,
+        )
+        return MicroBatcher(
+            engine,
+            max_delay_ms=self._max_delay_ms,
+            pipeline_depth=self._pipeline_depth,
+            host_featurize=self._host_featurize,
+        )
+
+    def swap_model(self, fitted, *, aot_store=_UNCHANGED) -> bool:
+        """Re-point the gateway at a DIFFERENT fitted pipeline and
+        rotate every lane onto engines built from it — the promotion
+        (and rollback) primitive: build + warm outside the pool lock,
+        then the same atomic per-lane ``swap_engine`` a rebucket uses,
+        so in-flight windows finish on the old model and nothing is
+        dropped. Returns False when ``close()`` won the race (nothing
+        rotated); on a build failure the previous fitted (and AOT
+        store, when ``aot_store`` was passed) is restored and the old
+        engines keep serving. Rolling BACK a promotion is just
+        ``swap_model(incumbent)`` — engines rebuilt from the identical
+        fitted serve bitwise-identical outputs."""
+        if self._engine_factory is not None:
+            raise RuntimeError(
+                f"gateway {self.name} runs on an engine-factory "
+                "override (zoo CSE plane); swap_model cannot rebuild "
+                "its engines from a fitted pipeline"
+            )
+        with self._swap_lock:
+            prev_fitted, prev_store = self.fitted, self._aot_store
+            self.fitted = fitted
+            if aot_store is not _UNCHANGED:
+                self._aot_store = aot_store
+            try:
+                ok = self._build_and_swap(self._buckets)
+            except Exception:
+                self.fitted, self._aot_store = prev_fitted, prev_store
+                raise
+            if not ok:
+                self.fitted, self._aot_store = prev_fitted, prev_store
+            return ok
 
     def swap_engines(
         self, buckets: Sequence[int], background: bool = False

@@ -1,0 +1,23 @@
+"""Online model lifecycle: streaming refit → shadow → canary → swap
+with auto-rollback (see README "Online model lifecycle").
+
+Only the dependency-light modules are eager (``policy`` is pure
+dataclasses, ``manager`` is a dict behind a lock) — the controller
+stack pulls in jax/serving and is imported by the processes that
+actually run a lifecycle, not by everyone who routes to one."""
+
+from keystone_tpu.lifecycle.manager import LifecycleManager
+from keystone_tpu.lifecycle.policy import (
+    GateInputs,
+    PolicyState,
+    PromotionConfig,
+    tick,
+)
+
+__all__ = [
+    "GateInputs",
+    "LifecycleManager",
+    "PolicyState",
+    "PromotionConfig",
+    "tick",
+]

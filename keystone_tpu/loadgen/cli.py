@@ -46,10 +46,35 @@ from keystone_tpu.loadgen.invariants import (
 )
 from keystone_tpu.loadgen.runner import (
     FaultPlan,
+    FeedbackSender,
     HttpTarget,
     InprocTarget,
     LoadGenerator,
 )
+
+
+def _parse_teacher(spec: str) -> dict:
+    """``hidden=H,depth=N[,seed=S][,head_seed=S2]`` -> kwargs for
+    ``lifecycle/teacher.teacher_labels`` (all integers)."""
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, value = part.partition("=")
+        key = key.strip()
+        if key not in ("hidden", "depth", "seed", "head_seed"):
+            raise SystemExit(
+                f"--teacher: unknown key {key!r} (want hidden/depth/"
+                "seed/head_seed)"
+            )
+        try:
+            out[key] = int(value)
+        except ValueError:
+            raise SystemExit(f"--teacher: {key} wants an integer")
+    if "hidden" not in out or "depth" not in out:
+        raise SystemExit("--teacher needs at least hidden=H,depth=N")
+    return out
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -107,6 +132,24 @@ def build_parser() -> argparse.ArgumentParser:
                     help="keep the run open this long past the last "
                     "arrival (lets post-fault recovery be measured)")
     wl.add_argument("--max-outstanding", type=int, default=128)
+
+    fb = ap.add_argument_group("lifecycle feedback")
+    fb.add_argument("--feedback-fraction", type=float, default=0.0,
+                    metavar="F",
+                    help="also label this deterministic fraction of "
+                    "issued payloads with the --teacher model and "
+                    "POST them to the gateway's /feedback (the "
+                    "online-lifecycle label stream; off the load "
+                    "path, bounded queue, drop-newest). Needs "
+                    "--target and --teacher")
+    fb.add_argument("--teacher", default=None,
+                    metavar="hidden=H,depth=N[,seed=S][,head_seed=S2]",
+                    help="synthetic ground truth for --feedback-"
+                    "fraction: lifecycle/teacher.teacher_labels over "
+                    "the --d input shape — the demo pipeline's exact "
+                    "forward math; head_seed redraws the final layer "
+                    "so the served model is a STALE teacher the "
+                    "streaming refit must catch up to")
 
     ch = ap.add_argument_group("chaos")
     ch.add_argument("--fault", action="append", default=[],
@@ -227,6 +270,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         target = HttpTarget(args.target, default_shape=(args.d,))
     else:
         raise SystemExit("pass --target URL or --self-gateway")
+    feedback = None
+    if args.feedback_fraction > 0.0:
+        if not args.target:
+            raise SystemExit(
+                "--feedback-fraction needs --target URL (the "
+                "/feedback route lives on the HTTP frontend)"
+            )
+        if not args.teacher:
+            raise SystemExit(
+                "--feedback-fraction needs --teacher "
+                "hidden=H,depth=N[,seed=S][,head_seed=S2]"
+            )
+        from keystone_tpu.lifecycle.teacher import teacher_labels
+
+        teacher_kw = _parse_teacher(args.teacher)
+        d = args.d
+        feedback = FeedbackSender(
+            args.target,
+            lambda xs: teacher_labels(xs, d, **teacher_kw),
+            fraction=args.feedback_fraction,
+        )
+        target.feedback = feedback
     # env-armed faults (KEYSTONE_FAULTS) arm AFTER the gateway exists:
     # trigger points disarm instantly when nothing has registered for
     # them, so arming earlier would silently no-op gateway.swap.force
@@ -260,6 +325,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         fired_after = {p: target.fired_count(p) for p in fault_points}
     finally:
+        if feedback is not None:
+            # flush BEFORE any verdict: the lifecycle drill's asserts
+            # read these counts off this one JSON line
+            print(
+                json.dumps({"feedback": feedback.close()}), flush=True
+            )
         if gateway is not None:
             gateway.close()
 

@@ -102,6 +102,15 @@ FAULT_POINTS: Dict[str, str] = {
         "must stay green (match: replica=<host:port> or "
         "index=<registration order>)"
     ),
+    "lifecycle.refit.poison": (
+        "corrupt @ lifecycle/refit.py RefitAccumulator — one "
+        "accumulated feedback chunk's targets are scaled to garbage "
+        "BEFORE they fold into the normal equations (the held-out "
+        "buffer stays clean), so the next solved candidate is wrong; "
+        "the lifecycle's accuracy gate must catch it on the held-out "
+        "comparison and auto-roll the candidate back within one "
+        "policy tick (match: model=<id>)"
+    ),
     "router.trace.drop": (
         "drop @ fleet/router.py _predict — the W3C traceparent "
         "header is stripped off the matched forward, so the replica "

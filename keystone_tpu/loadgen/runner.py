@@ -29,8 +29,10 @@ into a verdict."""
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 import logging
+import queue
 import threading
 import time
 import urllib.error
@@ -179,18 +181,122 @@ def _payload_for(event: TraceEvent, default_shape) -> np.ndarray:
     ).astype(np.float32)
 
 
-class HttpTarget:
-    """POST /predict against a live gateway frontend."""
+class FeedbackSender:
+    """Labeled-feedback side channel for lifecycle drills: a sampled
+    fraction of the payloads the generator POSTs also get labeled by
+    a ``labeler`` (e.g. ``lifecycle/teacher.teacher_labels``) and
+    POSTed to the gateway's ``/feedback`` — off the load path, on one
+    background thread, with a bounded drop-newest queue so a slow
+    labeler or a melting server can never backpressure the open-loop
+    arrival clock. Sampling is the same deterministic integer-part
+    arithmetic as the canary router: ``fraction`` of offers, evenly
+    spaced, no RNG."""
 
     def __init__(
-        self, base_url: str, default_shape: Sequence[int] = (8,)
+        self,
+        base_url: str,
+        labeler,
+        fraction: float = 0.25,
+        max_queue: int = 64,
+        timeout_s: float = 30.0,
+    ):
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1], got {fraction}")
+        self.base_url = base_url.rstrip("/")
+        self._labeler = labeler
+        self.fraction = float(fraction)
+        self.timeout_s = float(timeout_s)
+        self._q: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._seq = itertools.count()
+        self._lock = threading.Lock()
+        self._sent = 0  # guarded-by: _lock
+        self._dropped = 0  # guarded-by: _lock
+        self._errors = 0  # guarded-by: _lock
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._drain,
+            name="keystone-loadgen-feedback",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def offer(self, xs: np.ndarray) -> None:
+        """Maybe-enqueue one request's instances (called on the issue
+        path — MUST stay O(1) and non-blocking)."""
+        seq = next(self._seq)
+        f = self.fraction
+        if f <= 0.0 or int((seq + 1) * f) <= int(seq * f):
+            return
+        try:
+            self._q.put_nowait(xs)
+        except queue.Full:
+            with self._lock:
+                self._dropped += 1
+
+    def _drain(self) -> None:
+        while not (self._stop.is_set() and self._q.empty()):
+            try:
+                xs = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                ys = np.asarray(self._labeler(xs))
+                body = json.dumps(
+                    {"instances": xs.tolist(), "labels": ys.tolist()}
+                ).encode("utf-8")
+                req = urllib.request.Request(
+                    self.base_url + "/feedback",
+                    data=body,
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(
+                    req, timeout=self.timeout_s
+                ) as resp:
+                    resp.read()
+                with self._lock:
+                    self._sent += int(xs.shape[0])
+            except Exception:
+                with self._lock:
+                    self._errors += 1
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "sent": self._sent,
+                "dropped": self._dropped,
+                "errors": self._errors,
+            }
+
+    def close(self, timeout: float = 15.0) -> Dict[str, int]:
+        """Flush the queue, stop the thread, return final stats."""
+        self._stop.set()
+        self._thread.join(timeout)
+        return self.stats()
+
+
+class HttpTarget:
+    """POST /predict (or /predict/<model> for events carrying a model
+    id) against a live gateway frontend. ``feedback`` (a
+    ``FeedbackSender``) mirrors a sampled fraction of payloads to
+    POST /feedback as labeled examples — the lifecycle drill's
+    traffic-correlated label stream."""
+
+    def __init__(
+        self,
+        base_url: str,
+        default_shape: Sequence[int] = (8,),
+        feedback: Optional[FeedbackSender] = None,
     ):
         self.base_url = base_url.rstrip("/")
         self.default_shape = tuple(default_shape)
+        self.feedback = feedback
 
     def send(self, event: TraceEvent) -> RequestRecord:
         # index/t_* are stamped by the generator; this fills the rest
         xs = _payload_for(event, self.default_shape)
+        if self.feedback is not None:
+            self.feedback.offer(xs)
         doc: Dict[str, Any] = {"instances": xs.tolist()}
         if event.deadline_ms is not None:
             doc["deadline_ms"] = event.deadline_ms
@@ -201,8 +307,11 @@ class HttpTarget:
         timeout = SERVER_RESULT_BOUND_S + 15.0 + (
             event.deadline_ms / 1e3 if event.deadline_ms else 0.0
         )
+        path = (
+            "/predict/" + event.model if event.model else "/predict"
+        )
         req = urllib.request.Request(
-            self.base_url + "/predict",
+            self.base_url + path,
             data=body,
             headers={"Content-Type": "application/json"},
             method="POST",
@@ -561,6 +670,7 @@ class LoadGenerator:
 __all__ = [
     "FaultPlan",
     "FaultWindow",
+    "FeedbackSender",
     "HttpTarget",
     "InprocTarget",
     "LoadGenerator",

@@ -65,6 +65,11 @@ class TraceEvent:
     # along for analysis, don't drive the replay
     replica: Optional[str] = None
     attempts: Optional[int] = None
+    # zoo mode: the named model that served the instance (None on the
+    # bare single-model route). This one DOES drive the replay — the
+    # HTTP target POSTs /predict/<model> when set, so a recorded
+    # multi-model mix replays against the same per-model lanes
+    model: Optional[str] = None
 
 
 def parse_request_log_line(line: str) -> Optional[TraceEvent]:
@@ -102,6 +107,7 @@ def parse_request_log_line(line: str) -> Optional[TraceEvent]:
             post_seq=doc.get("post_seq"),
             replica=doc.get("replica"),
             attempts=doc.get("attempts"),
+            model=doc.get("model"),
         )
     except (TypeError, ValueError):
         return None
@@ -151,6 +157,7 @@ def collapse_posts(events: Sequence[TraceEvent]) -> List[TraceEvent]:
             and events[i + run].n_rows == head.n_rows
             and events[i + run].shape == head.shape
             and events[i + run].deadline_ms == head.deadline_ms
+            and events[i + run].model == head.model
             and events[i + run].ts - head.ts <= _POST_WINDOW_S
         ):
             run += 1

@@ -157,6 +157,51 @@ def build_pipeline(
     return pipe.to_pipeline().fit()
 
 
+def affine_head(W, b):
+    """One ``tanh(x @ W + b)`` node as a standalone FittedPipeline —
+    the refittable HEAD the online-lifecycle loop re-solves.
+    ``base.and_then(affine_head(W, b))`` composes it back onto a
+    feature base; with the weights drawn by ``build_split_pipeline``
+    the composition is the same graph ``build_pipeline`` builds."""
+    W = jnp.asarray(np.asarray(W, np.float32))
+    b = jnp.asarray(np.asarray(b, np.float32))
+    return _Affine(W, b).to_pipeline().to_pipeline().fit()
+
+
+def build_split_pipeline(
+    d: int = 256, hidden: int = 512, depth: int = 4, seed: int = 0
+):
+    """``build_pipeline`` split at the last layer: returns
+    ``(base, W, b)`` where ``base`` is the first ``depth - 1`` layers
+    (the frozen featurizer the refit accumulator reads activations
+    from) and ``(W, b)`` is the final layer's weights.
+    ``base.and_then(affine_head(W, b))`` serves OUTPUTS BITWISE EQUAL
+    to ``build_pipeline(d, hidden, depth, seed)`` — the rng stream is
+    drawn in the identical order — so a gateway can boot on the split
+    form and the lifecycle loop can re-solve just the head."""
+    if depth < 2:
+        raise ValueError(f"split needs depth >= 2, got {depth}")
+    rng = np.random.default_rng(seed)
+    dims = [d] + [hidden] * (depth - 1) + [d]
+    pipe = None
+    for i in range(depth - 1):
+        w = jnp.asarray(
+            rng.standard_normal((dims[i], dims[i + 1])).astype(np.float32)
+            / np.sqrt(dims[i])
+        )
+        b = jnp.asarray(np.zeros(dims[i + 1], np.float32))
+        node = _Affine(w, b)
+        pipe = node.to_pipeline() if pipe is None else pipe.and_then(node)
+    head_w = jnp.asarray(
+        rng.standard_normal((dims[depth - 1], dims[depth])).astype(
+            np.float32
+        )
+        / np.sqrt(dims[depth - 1])
+    )
+    head_b = jnp.asarray(np.zeros(dims[depth], np.float32))
+    return pipe.to_pipeline().fit(), head_w, head_b
+
+
 def bench_cold_vs_warm(
     emit, fitted, buckets: Sequence[int], d: int, warm_reps: int = 30
 ) -> None:
@@ -475,6 +520,171 @@ def bench_swap_blip(
                 "swaps": int(gw.metrics.swap_count()),
                 "failures": failures[0],
                 "buckets_after": list(gw.buckets),
+            },
+        )
+
+
+def bench_online_refit(
+    emit,
+    d: int = 24,
+    hidden: int = 32,
+    depth: int = 3,
+    buckets: Sequence[int] = (4, 16),
+    n_threads: int = 4,
+    max_ticks: int = 60,
+) -> None:
+    """``serving_online_refit`` — the full online-lifecycle loop, both
+    directions, under open-loop load:
+
+    1. PROMOTION: the gateway serves a STALE head (the teacher's final
+       layer was redrawn); labeled feedback streams in; the controller
+       solves a candidate and walks it shadow → canary → promoted
+       (atomic engine swap) while client threads hammer /predict.
+       Asserted: ZERO failed requests across the whole rollout (the
+       swap-blip discipline of ``serving_swap_blip``), the candidate's
+       held-out error BEATS the stale incumbent's, and the promoted
+       model now serves.
+    2. ROLLBACK: ``lifecycle.refit.poison`` is armed, so the next
+       feedback window folds garbage into the normal equations; the
+       solved candidate must be caught by the held-out accuracy gate
+       and auto-rolled back within ONE policy tick of entering shadow
+       — with the incumbent's serving never perturbed (candidates
+       only ever saw mirrored traffic).
+
+    The emitted value is the p99 client latency across phase 1 — the
+    price of running an entire model rollout under live load."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.gateway import Gateway
+    from keystone_tpu.lifecycle.controller import LifecycleController
+    from keystone_tpu.lifecycle.policy import PromotionConfig
+    from keystone_tpu.lifecycle.teacher import teacher_labels
+    from keystone_tpu.loadgen import faults
+
+    head_seed = 77  # the teacher the refit must catch up to
+    base, head_w, head_b = build_split_pipeline(
+        d=d, hidden=hidden, depth=depth, seed=0
+    )
+    stale = base.and_then(affine_head(head_w, head_b))
+    rng = np.random.default_rng(11)
+    examples = rng.standard_normal((256, d)).astype(np.float32)
+
+    def labeled(n):
+        xs = rng.standard_normal((n, d)).astype(np.float32)
+        return xs, teacher_labels(
+            xs, d, hidden, depth, seed=0, head_seed=head_seed
+        )
+
+    with Gateway(
+        stale, buckets=buckets, n_lanes=2, max_delay_ms=2.0,
+        warmup_example=jnp.zeros((d,), jnp.float32),
+        name="bench-lifecycle",
+    ) as gw:
+        ctrl = LifecycleController(
+            gw, base=base, head_builder=affine_head,
+            feature_dim=hidden, out_dim=d, name="bench",
+            config=PromotionConfig(
+                min_shadow_pairs=8, min_canary_requests=8,
+                promote_after_healthy_ticks=1,
+            ),
+            canary_fraction=0.25, min_refit_samples=128,
+            interval_s=None, refit_chunk=32,
+        )
+        stop = threading.Event()
+        lat: list = [[] for _ in range(n_threads)]
+        fails = [0] * n_threads
+
+        def client(tid):
+            i = tid
+            while not stop.is_set():
+                t = time.perf_counter()
+                try:
+                    gw.predict(
+                        examples[i % len(examples)]
+                    ).result(timeout=60)
+                except Exception:
+                    fails[tid] += 1
+                lat[tid].append(time.perf_counter() - t)
+                i += n_threads
+
+        threads = [
+            threading.Thread(target=client, args=(t,), daemon=True)
+            for t in range(n_threads)
+        ]
+        try:
+            # -- phase 1: promotion under load
+            ctrl.add_feedback(*labeled(384))
+            for t in threads:
+                t.start()
+            t0 = time.perf_counter()
+            ticks = 0
+            status = ctrl.status()
+            while status["state"] != "promoted" and ticks < max_ticks:
+                status = ctrl.tick()
+                ticks += 1
+                time.sleep(0.05)  # let mirrored/canary traffic flow
+            promote_s = time.perf_counter() - t0
+            cand_err = status["errors"]["candidate"]
+            inc_err = status["errors"]["incumbent"]
+            if status["state"] != "promoted":
+                raise RuntimeError(
+                    f"candidate not promoted after {ticks} ticks: "
+                    f"{status}"
+                )
+            if not (cand_err is not None and inc_err is not None
+                    and cand_err < inc_err):
+                raise RuntimeError(
+                    "promoted candidate does not beat the stale "
+                    f"incumbent on held-out labels: candidate="
+                    f"{cand_err} incumbent={inc_err}"
+                )
+            # -- phase 2: poisoned refit must auto-roll back
+            faults.get_injector().arm(
+                "lifecycle.refit.poison", count=8
+            )
+            try:
+                ctrl.add_feedback(*labeled(384))
+                status = ctrl.tick()  # solves v2, arms its shadow
+                rb_ticks = 0
+                while (status["state"] != "rolled_back"
+                       and rb_ticks < 3):
+                    status = ctrl.tick()
+                    rb_ticks += 1
+            finally:
+                faults.get_injector().disarm("lifecycle.refit.poison")
+            if status["state"] != "rolled_back":
+                raise RuntimeError(
+                    f"poisoned candidate was not rolled back: {status}"
+                )
+            if rb_ticks > 1:
+                raise RuntimeError(
+                    "rollback took more than one policy tick after "
+                    f"shadow start ({rb_ticks})"
+                )
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            ctrl.close()
+        failures = sum(fails)
+        if failures:
+            raise RuntimeError(
+                f"{failures} requests failed across the live rollout"
+            )
+        latencies = [x for sub in lat for x in sub]
+        emit(
+            "serving_online_refit",
+            float(np.percentile(latencies, 99)) * 1e3, "ms",
+            extra={
+                "requests": len(latencies),
+                "failures": failures,
+                "ticks_to_promote": ticks,
+                "promote_wall_s": round(promote_s, 2),
+                "candidate_err": cand_err,
+                "incumbent_err": inc_err,
+                "rollback_reason": status["last_reason"],
+                "rollback_ticks_after_shadow": rb_ticks,
+                "promotions": status["promotions"],
             },
         )
 
@@ -2647,6 +2857,16 @@ def run_zoo_benches(emit) -> None:
     bench_zoo(emit)
 
 
+def run_lifecycle_benches(emit) -> None:
+    """The online-lifecycle row alone (``--lifecycle-only``, what
+    ``bin/smoke-rollout.sh`` invokes): streaming refit → shadow →
+    canary → promote under open-loop load, then a poisoned refit
+    auto-rolled back. Owns its (small) pipeline shape — the drill
+    runs several engine builds, so the generic bench dims would turn
+    it into a compile benchmark."""
+    bench_online_refit(emit)
+
+
 def run_shard_benches(emit) -> None:
     """The model-axis A/B alone (``--shard-only``, what
     ``bin/smoke-shard.sh`` invokes; ~60 s of gateway warmups across
@@ -2669,6 +2889,7 @@ def run_serving_benches(
     featurize: bool = False,
     shard: bool = False,
     zoo: bool = False,
+    lifecycle: bool = False,
 ) -> None:
     fitted = build_pipeline(d, hidden, depth)
     bench_cold_vs_warm(emit, fitted, buckets, d)
@@ -2715,6 +2936,8 @@ def run_serving_benches(
         run_shard_benches(emit)
     if zoo:
         run_zoo_benches(emit)
+    if lifecycle:
+        run_lifecycle_benches(emit)
     if autoscale:
         # its own (smaller) pipeline: scale-up reaction time includes
         # per-replica warmup, which the default bench shape would
@@ -2818,6 +3041,18 @@ def main(argv=None) -> int:
     ap.add_argument("--zoo-only", action="store_true",
                     help="run ONLY the model-zoo CSE row (what "
                     "bin/smoke-zoo.sh invokes)")
+    ap.add_argument("--lifecycle", action="store_true",
+                    help="also run the online-lifecycle row "
+                    "(serving_online_refit): streaming refit from "
+                    "labeled feedback promoted shadow -> canary -> "
+                    "swap under open-loop load with zero failed "
+                    "requests asserted, then a refit poisoned via "
+                    "lifecycle.refit.poison auto-rolled back by the "
+                    "held-out accuracy gate within one policy tick "
+                    "(~30s)")
+    ap.add_argument("--lifecycle-only", action="store_true",
+                    help="run ONLY the online-lifecycle row (what "
+                    "bin/smoke-rollout.sh invokes)")
     ap.add_argument("--shard", action="store_true",
                     help="also run the model-axis A/B "
                     "(serving_sharded_vs_replicated): the same model "
@@ -2876,6 +3111,8 @@ def main(argv=None) -> int:
             run_featurize_benches(emit)
         elif args.zoo_only:
             run_zoo_benches(emit)
+        elif args.lifecycle_only:
+            run_lifecycle_benches(emit)
         elif args.autoscale_only:
             run_autoscale_benches(emit)
         elif args.fleet_only:
@@ -2898,6 +3135,7 @@ def main(argv=None) -> int:
                 featurize=args.featurize,
                 shard=args.shard,
                 zoo=args.zoo,
+                lifecycle=args.lifecycle,
             )
 
     if args.profile_dir:

@@ -142,6 +142,8 @@ class ModelZoo:
         self._artifacts: Dict[str, BuiltModel] = {}
         self._artifacts_lock = threading.Lock()
         self._closed = False
+        # optional per-model online-lifecycle plane (attach_lifecycle)
+        self.lifecycle = None
         from keystone_tpu.observability.registry import (
             get_global_registry,
         )
@@ -641,11 +643,40 @@ class ModelZoo:
             "actual": actual,
         }
 
+    # -- online lifecycle --------------------------------------------------
+
+    def attach_lifecycle(self, manager) -> None:
+        """Adopt a ``LifecycleManager`` whose controllers drive this
+        zoo's per-model gateways. The HTTP frontend resolves its
+        lifecycle surface (``/feedback/<model>``, ``/lifecyclez``)
+        through this attribute in zoo mode, so per-model streaming
+        refit works identically with many resident models. NOTE:
+        controllers only work over SOLO units — a model in a
+        cross-model CSE group serves through a shared engine the
+        lifecycle cannot rebuild from one fitted
+        (``Gateway.swap_model`` raises on those)."""
+        self.lifecycle = manager
+
+    def lifecycle_status(self) -> Optional[Dict[str, Any]]:
+        """The attached manager's ``/lifecyclez`` document (None when
+        no lifecycle plane is attached)."""
+        return (
+            self.lifecycle.status()
+            if self.lifecycle is not None else None
+        )
+
     # -- shutdown ----------------------------------------------------------
 
     def close(self, timeout: Optional[float] = 10.0) -> None:
         """Drain every unit concurrently (one slow model must not
         serialize the others' drains behind it)."""
+        if self.lifecycle is not None:
+            # the refit/tick plane dies first: a tick mid-drain would
+            # race swap_model against the unit drains below
+            try:
+                self.lifecycle.close()
+            except Exception:
+                logger.exception("zoo lifecycle close failed")
         with self._lock:
             if self._closed:
                 units = []

@@ -1,0 +1,13 @@
+import pytest
+
+from keystone_tpu.loadgen import faults
+
+
+@pytest.fixture(autouse=True)
+def clean_injector():
+    """The injector is process-global: every lifecycle test starts
+    and ends with nothing armed, so the poison drills can't leak into
+    the rest of the suite."""
+    faults.disarm_all()
+    yield
+    faults.disarm_all()

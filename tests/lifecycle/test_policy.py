@@ -1,0 +1,188 @@
+"""The pure promotion state machine: gate thresholds, the hysteresis
+band (no canary↔rollback flapping), terminal states, and the
+deterministic canary-fraction arithmetic."""
+
+import pytest
+
+from keystone_tpu.lifecycle.policy import (
+    GateInputs,
+    PolicyState,
+    PromotionConfig,
+    tick,
+)
+
+CFG = PromotionConfig(
+    min_shadow_pairs=4,
+    max_shadow_diff=0.5,
+    min_canary_requests=4,
+    max_canary_error_rate=0.25,
+    promote_after_healthy_ticks=2,
+)
+
+GOOD = {"candidate_err": 0.01, "incumbent_err": 0.5}
+BAD = {"candidate_err": 5.0, "incumbent_err": 0.5}
+# between promote_err_ratio (1.0) and rollback_err_ratio (1.5)
+MARGINAL = {"candidate_err": 0.6, "incumbent_err": 0.5}
+
+
+def test_candidate_always_shadows_first():
+    state, reason = tick(PolicyState("candidate"), GateInputs(), CFG)
+    assert state.stage == "shadow"
+    assert reason == "shadow_start"
+
+
+def test_shadow_waits_for_pairs():
+    state, reason = tick(
+        PolicyState("shadow"), GateInputs(shadow_pairs=1, **GOOD), CFG
+    )
+    assert state.stage == "shadow"
+    assert reason == "shadow_wait"
+
+
+def test_shadow_advances_on_pairs_and_good_accuracy():
+    state, reason = tick(
+        PolicyState("shadow"), GateInputs(shadow_pairs=4, **GOOD), CFG
+    )
+    assert state.stage == "canary"
+    assert reason == "canary_start"
+
+
+def test_shadow_unknown_accuracy_blocks_but_never_rolls_back():
+    state, reason = tick(
+        PolicyState("shadow"), GateInputs(shadow_pairs=64), CFG
+    )
+    assert state.stage == "shadow"
+    assert reason == "shadow_wait"
+
+
+def test_shadow_bad_accuracy_rolls_back_without_pair_evidence():
+    # the poisoned-refit path: held-out accuracy alone is enough,
+    # no shadow traffic required
+    state, reason = tick(
+        PolicyState("shadow"), GateInputs(shadow_pairs=0, **BAD), CFG
+    )
+    assert state.stage == "rolled_back"
+    assert reason == "accuracy"
+
+
+def test_shadow_diff_backstop_without_holdout_proof():
+    state, reason = tick(
+        PolicyState("shadow"),
+        GateInputs(shadow_pairs=8, shadow_max_abs=2.0),
+        CFG,
+    )
+    assert state.stage == "rolled_back"
+    assert reason == "shadow_diff"
+
+
+def test_shadow_diff_tolerated_when_accuracy_proven_good():
+    # a refit that corrects a stale incumbent's drift legitimately
+    # diverges from it — proven-good candidates may differ
+    state, reason = tick(
+        PolicyState("shadow"),
+        GateInputs(shadow_pairs=8, shadow_max_abs=2.0, **GOOD),
+        CFG,
+    )
+    assert state.stage == "canary"
+
+
+def test_canary_promotes_after_consecutive_healthy_ticks():
+    inputs = GateInputs(canary_requests=8, **GOOD)
+    state, reason = tick(PolicyState("canary"), inputs, CFG)
+    assert state.stage == "canary"
+    assert state.healthy_streak == 1
+    assert reason == "canary_healthy"
+    state, reason = tick(state, inputs, CFG)
+    assert state.stage == "promoted"
+    assert reason == "promoted"
+
+
+def test_canary_error_rate_rolls_back():
+    state, reason = tick(
+        PolicyState("canary"),
+        GateInputs(canary_requests=8, canary_errors=4, **GOOD),
+        CFG,
+    )
+    assert state.stage == "rolled_back"
+    assert reason == "canary_errors"
+
+
+def test_canary_slo_burn_rolls_back():
+    state, reason = tick(
+        PolicyState("canary"),
+        GateInputs(canary_requests=8, slo_breaching=True, **GOOD),
+        CFG,
+    )
+    assert state.stage == "rolled_back"
+    assert reason == "slo_burn"
+
+
+def test_canary_bad_accuracy_rolls_back():
+    state, reason = tick(
+        PolicyState("canary"), GateInputs(canary_requests=8, **BAD),
+        CFG,
+    )
+    assert state.stage == "rolled_back"
+    assert reason == "accuracy"
+
+
+def test_hysteresis_marginal_resets_streak_without_rollback():
+    # the no-flap property: a candidate bouncing between good and
+    # marginal windows neither rolls back nor promotes early — it
+    # just never accumulates the streak
+    state = PolicyState("canary")
+    good = GateInputs(canary_requests=8, **GOOD)
+    marginal = GateInputs(canary_requests=8, **MARGINAL)
+    for _ in range(10):
+        state, reason = tick(state, good, CFG)
+        assert state.stage == "canary"
+        assert state.healthy_streak == 1
+        state, reason = tick(state, marginal, CFG)
+        assert state.stage == "canary", "hysteresis band rolled back"
+        assert state.healthy_streak == 0
+        assert reason == "canary_wait"
+
+
+def test_terminal_states_stay_terminal():
+    for stage in ("promoted", "rolled_back"):
+        state, reason = tick(
+            PolicyState(stage), GateInputs(canary_requests=100, **BAD),
+            CFG,
+        )
+        assert state.stage == stage
+        assert reason == "terminal"
+        assert state.terminal
+
+
+def test_idle_does_nothing():
+    state, reason = tick(PolicyState("idle"), GateInputs(), CFG)
+    assert state.stage == "idle"
+    assert reason == "idle"
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        PromotionConfig(promote_err_ratio=2.0, rollback_err_ratio=1.5)
+    with pytest.raises(ValueError):
+        PromotionConfig(promote_err_ratio=0.0)
+
+
+def test_canary_takes_deterministic_fraction():
+    from keystone_tpu.gateway.pool import canary_takes
+
+    for fraction, expect in ((0.0, 0), (0.25, 25), (0.5, 50),
+                             (0.1, 10), (1.0, 100)):
+        takes = [canary_takes(i, fraction) for i in range(100)]
+        assert sum(takes) == expect, fraction
+        # deterministic: same sequence twice
+        assert takes == [canary_takes(i, fraction) for i in range(100)]
+
+
+def test_canary_takes_evenly_spaced():
+    from keystone_tpu.gateway.pool import canary_takes
+
+    # integer-part advance: over any window of 1/f requests, exactly
+    # one is taken — the canary load is smooth, not bursty
+    taken = [i for i in range(1000) if canary_takes(i, 0.125)]
+    gaps = {b - a for a, b in zip(taken, taken[1:])}
+    assert gaps == {8}

@@ -1,0 +1,151 @@
+"""Shadow mirror + canary router against fake batchers: diff stats,
+the off-response-path contract (a broken candidate costs served
+traffic nothing), bounded mirror in-flight, and the canary's
+incumbent fallback."""
+
+import threading
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from keystone_tpu.lifecycle.routes import CanaryRouter, ShadowMirror
+
+
+class FakeBatcher:
+    """Resolves each submit synchronously through ``fn`` — or holds
+    the futures for manual resolution when ``manual=True``."""
+
+    def __init__(self, fn=None, manual=False):
+        self.fn = fn or (lambda x: np.asarray(x) * 2.0)
+        self.manual = manual
+        self.held = []
+        self.submits = 0
+
+    def submit(self, example, parent_span_id=None):
+        self.submits += 1
+        fut = Future()
+        if self.manual:
+            self.held.append((example, fut))
+        else:
+            fut.set_result(self.fn(example))
+        return fut
+
+
+def _done(value):
+    f = Future()
+    f.set_result(np.asarray(value, np.float32))
+    return f
+
+
+def test_mirror_diff_stats():
+    mirror = ShadowMirror(FakeBatcher(lambda x: np.asarray(x) + 1.0))
+    x = np.ones(4, np.float32)
+    mirror.observe(x, _done(x))  # shadow = x+1 -> diff 1.0 everywhere
+    stats = mirror.stats()
+    assert stats["pairs"] == 1
+    assert stats["mean_abs"] == pytest.approx(1.0)
+    assert stats["max_abs"] == pytest.approx(1.0)
+    assert stats["errors"] == 0
+
+
+def test_mirror_never_raises_on_broken_candidate():
+    class Exploding:
+        def submit(self, example, parent_span_id=None):
+            raise RuntimeError("candidate engine is gone")
+
+    mirror = ShadowMirror(Exploding())
+    mirror.observe(np.ones(4), _done(np.ones(4)))  # must not raise
+    stats = mirror.stats()
+    assert stats["errors"] == 1
+    assert stats["pairs"] == 0
+
+
+def test_mirror_counts_shadow_errors():
+    batcher = FakeBatcher(manual=True)
+    mirror = ShadowMirror(batcher)
+    mirror.observe(np.ones(4), _done(np.ones(4)))
+    _, fut = batcher.held[0]
+    fut.set_exception(RuntimeError("candidate dispatch failed"))
+    stats = mirror.stats()
+    assert stats["errors"] == 1
+    assert stats["pairs"] == 0
+
+
+def test_mirror_bounded_inflight_drops_newest():
+    batcher = FakeBatcher(manual=True)  # shadows never resolve
+    mirror = ShadowMirror(batcher, max_inflight=3)
+    for _ in range(5):
+        mirror.observe(np.ones(4), _done(np.ones(4)))
+    stats = mirror.stats()
+    assert stats["dropped"] == 2
+    assert batcher.submits == 3
+
+
+def test_mirror_pairs_with_pending_primary():
+    # the primary can resolve AFTER the shadow: the diff chains off
+    # the primary's callback instead of blocking the delivery thread
+    mirror = ShadowMirror(FakeBatcher(lambda x: np.asarray(x)))
+    primary = Future()
+    mirror.observe(np.ones(4), primary)
+    assert mirror.stats()["pairs"] == 0
+    primary.set_result(np.ones(4, np.float32))
+    stats = mirror.stats()
+    assert stats["pairs"] == 1
+    assert stats["max_abs"] == pytest.approx(0.0)
+
+
+def test_canary_takes_fraction():
+    router = CanaryRouter(FakeBatcher(), 0.25)
+    takes = [router.takes() for _ in range(100)]
+    assert sum(takes) == 25
+
+
+def test_canary_serves_from_candidate():
+    router = CanaryRouter(FakeBatcher(lambda x: np.asarray(x) * 3.0), 1.0)
+    out = Future()
+    router.route(np.ones(2, np.float32), None, out, fallback=lambda: None)
+    np.testing.assert_array_equal(
+        out.result(timeout=5), np.ones(2, np.float32) * 3.0
+    )
+    assert getattr(out, "canary", False) is True
+    assert router.stats() == {
+        "fraction": 1.0, "requests": 1, "errors": 0,
+    }
+
+
+def test_canary_submit_failure_falls_back():
+    class Exploding:
+        def submit(self, example, parent_span_id=None):
+            raise RuntimeError("no engine")
+
+    fell_back = []
+    router = CanaryRouter(Exploding(), 1.0)
+    out = Future()
+    router.route(
+        np.ones(2), None, out, fallback=lambda: fell_back.append(1)
+    )
+    assert fell_back == [1]
+    assert router.stats()["errors"] == 1
+    assert not out.done()  # the fallback path owns resolution now
+
+
+def test_canary_dispatch_failure_falls_back():
+    batcher = FakeBatcher(manual=True)
+    fell_back = []
+    router = CanaryRouter(batcher, 1.0)
+    out = Future()
+    router.route(
+        np.ones(2), None, out, fallback=lambda: fell_back.append(1)
+    )
+    _, fut = batcher.held[0]
+    fut.set_exception(RuntimeError("candidate died mid-flight"))
+    assert fell_back == [1]
+    assert router.stats()["errors"] == 1
+
+
+def test_canary_fraction_validation():
+    with pytest.raises(ValueError):
+        CanaryRouter(FakeBatcher(), 1.5)
+    with pytest.raises(ValueError):
+        CanaryRouter(FakeBatcher(), -0.1)

@@ -235,3 +235,75 @@ def test_inproc_target_untyped_error_is_flagged(fitted):
         assert rec.status == "error"
         assert rec.untyped
         assert "FaultInjected" in rec.reason
+
+
+class _FakeResponse:
+    def read(self):
+        return b"{}"
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def test_feedback_sender_samples_and_posts(monkeypatch):
+    import urllib.request
+
+    import numpy as np
+
+    from keystone_tpu.loadgen import runner
+
+    posted = []
+
+    def fake_urlopen(req, timeout=None):
+        posted.append(req)
+        return _FakeResponse()
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    sender = runner.FeedbackSender(
+        "http://example.invalid",
+        labeler=lambda xs: np.zeros_like(xs),
+        fraction=0.25,
+        max_queue=256,
+    )
+    for _ in range(100):
+        sender.offer(np.ones((2, 4), np.float32))
+    stats = sender.close()
+    # deterministic integer-part sampling: exactly fraction of offers
+    assert len(posted) == 25
+    assert stats["sent"] == 25 * 2  # rows, not requests
+    assert stats["dropped"] == 0
+    assert stats["errors"] == 0
+    assert all(r.full_url.endswith("/feedback") for r in posted)
+
+
+def test_feedback_sender_errors_never_block(monkeypatch):
+    import urllib.request
+
+    import numpy as np
+
+    from keystone_tpu.loadgen import runner
+
+    def exploding_urlopen(req, timeout=None):
+        raise OSError("connection refused")
+
+    monkeypatch.setattr(urllib.request, "urlopen", exploding_urlopen)
+    sender = runner.FeedbackSender(
+        "http://example.invalid",
+        labeler=lambda xs: np.zeros_like(xs),
+        fraction=1.0,
+    )
+    for _ in range(5):
+        sender.offer(np.ones((1, 4), np.float32))
+    stats = sender.close()
+    assert stats["errors"] == 5
+    assert stats["sent"] == 0
+
+
+def test_feedback_sender_fraction_validation():
+    from keystone_tpu.loadgen.runner import FeedbackSender
+
+    with pytest.raises(ValueError):
+        FeedbackSender("http://x", labeler=lambda xs: xs, fraction=1.5)

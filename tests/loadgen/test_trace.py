@@ -10,13 +10,17 @@ import pytest
 from keystone_tpu.loadgen import trace
 
 
-def _new_line(ts, n_rows=1, shape=(6,), deadline_ms=None, status=200):
-    return json.dumps({
+def _new_line(ts, n_rows=1, shape=(6,), deadline_ms=None, status=200,
+              model=None):
+    doc = {
         "ts": ts, "path": "/predict", "status": status,
         "latency_ms": 2.0, "lane": 0, "trace_id": "ab" * 16,
         "n_rows": n_rows, "shape": list(shape),
         "deadline_ms": deadline_ms,
-    })
+    }
+    if model is not None:
+        doc["model"] = model
+    return json.dumps(doc)
 
 
 def _old_line(ts, status=200):
@@ -59,6 +63,47 @@ def test_parse_skips_non_record_lines():
     ]
     events = trace.parse_request_log(lines)
     assert len(events) == 2
+
+
+def test_parse_model_round_trip():
+    # zoo / lifecycle gateways tag every request-log line with the
+    # model id; the parsed event must carry it so a replay hits the
+    # same per-model route (/predict/<model>)
+    ev = trace.parse_request_log_line(_new_line(1.0, model="resnet"))
+    assert ev.model == "resnet"
+    ev = trace.parse_request_log_line(_new_line(1.0))
+    assert ev.model is None
+
+
+def test_collapse_never_merges_across_models():
+    # two adjacent same-shape lines from DIFFERENT models are two
+    # POSTs — without the model guard the adjacency fallback would
+    # fold them into one
+    lines = [
+        _new_line(1.0, n_rows=2, model="a"),
+        _new_line(1.0, n_rows=2, model="b"),
+    ]
+    events = trace.collapse_posts(trace.parse_request_log(lines))
+    assert len(events) == 2
+    assert [e.model for e in events] == ["a", "b"]
+    # same model: the pair is one 2-instance POST again
+    lines = [
+        _new_line(1.0, n_rows=2, model="a"),
+        _new_line(1.0, n_rows=2, model="a"),
+    ]
+    events = trace.collapse_posts(trace.parse_request_log(lines))
+    assert len(events) == 1
+    assert events[0].model == "a"
+    assert events[0].n_rows == 2
+
+
+def test_normalize_preserves_model():
+    events = trace.parse_request_log(
+        [_new_line(5.0, model="m0"), _new_line(6.0, model="m0")]
+    )
+    normalized = trace.normalize(events)
+    assert normalized[0].ts == 0.0
+    assert all(e.model == "m0" for e in normalized)
 
 
 def test_collapse_folds_per_instance_lines_into_posts():
