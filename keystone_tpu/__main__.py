@@ -195,6 +195,10 @@ def main(argv=None) -> int:
               "serialize the executables so a brand-new host's "
               "serve-gateway goes from exec() to serving with zero "
               "XLA compiles; keystone_tpu/serving/aot.py)")
+        print("  bench-diff  (compare two bench-round JSONs and exit "
+              "nonzero on headline-metric regressions beyond per-row "
+              "tolerance — bin/bench-diff last-green.json "
+              "this-round.json; keystone_tpu/bench_diff.py)")
         print("  keystone-lint  (AST contract analyzer over this "
               "repo's own source: lock discipline, blocking-under-"
               "lock, strippable asserts, absent-not-zero metrics, "
@@ -279,6 +283,12 @@ def main(argv=None) -> int:
         from keystone_tpu.serving.aot import build_main
 
         return build_main(argv[1:])
+    if app == "bench-diff":
+        # stdlib-only like the linter: regression gating runs in CI
+        # hooks without paying the jax import
+        from keystone_tpu.bench_diff import main as bench_diff_main
+
+        return bench_diff_main(argv[1:])
     if app == "keystone-lint":
         # stdlib-only path by design: the linter must run in hooks and
         # CI without paying the jax import (analysis/ never imports it)

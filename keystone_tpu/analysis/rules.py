@@ -1,4 +1,4 @@
-"""The six repo-native rules. Each encodes a defect class review
+"""The seven repo-native rules. Each encodes a defect class review
 actually caught in PRs 1–8; the module docstring of each rule names
 the incident it generalizes.
 
@@ -810,6 +810,205 @@ class FaultPointDriftRule(Rule):
     # rule + message-stable anchor line text "").
 
 
+# -- rule 7: metric-family-drift ---------------------------------------------
+
+
+class MetricFamilyDriftRule(Rule):
+    """The ``keystone_*`` metric families registered in code and the
+    README's metric-family catalog table must agree in both
+    directions — a family operators can't find documented is a dark
+    series, and a documented family nothing registers is a dashboard
+    pointed at nothing.
+
+    Registration sites are the registry methods
+    (``counter``/``gauge``/``gauge_func``/``summary``/``histogram``/
+    ``latency``) and direct ``MetricFamily(...)`` construction, scanned
+    over the WHOLE package from disk like the fault-point rule (a
+    ``--changed-only`` slice must not make unchanged registrations look
+    undocumented). F-string family names (``f"keystone_attr_{f}_total"``)
+    become wildcard patterns: each must match at least one catalog row,
+    and rows they match count as registered.
+
+    Asymmetry by design: the registered→documented direction only
+    counts names the scan can prove are registered (literal first args
+    of registration calls), but the documented→registered direction
+    accepts any catalog row whose name appears as a string literal
+    anywhere in the package — families registered through a variable
+    (the ``device_families`` per-key loop) would otherwise read as
+    phantom rows."""
+
+    name = "metric-family-drift"
+    description = (
+        "registered keystone_* metric families and the README "
+        "metric-family catalog table must agree both ways"
+    )
+
+    _FAMILY_RE = re.compile(r"^keystone_[a-z0-9_]+$")
+    _README_ROW_RE = re.compile(r"^\|\s*`(keystone_[a-z0-9_]+)`")
+    _REGISTER_FUNCS = frozenset(
+        ("counter", "gauge", "gauge_func", "summary", "histogram",
+         "latency", "MetricFamily")
+    )
+
+    def __init__(
+        self,
+        readme_rel: str = "README.md",
+        package_rel: str = "keystone_tpu",
+        table_heading: str = "Metric-family catalog",
+    ):
+        self.readme_rel = readme_rel
+        self.package_rel = package_rel
+        self.table_heading = table_heading
+
+    def _registered(
+        self, project: Project
+    ) -> Tuple[
+        Dict[str, Tuple[str, int]],
+        List[Tuple["re.Pattern", str, str, int]],
+        Set[str],
+    ]:
+        """Literal family -> one registration site, the wildcard
+        patterns compiled from f-string registrations, and every
+        family-shaped string literal seen anywhere (the
+        phantom-suppression set for indirect registrations)."""
+        from keystone_tpu.analysis.core import iter_python_files
+
+        literals: Dict[str, Tuple[str, int]] = {}
+        patterns: List[Tuple[re.Pattern, str, str, int]] = []
+        mentioned: Set[str] = set()
+        for full in iter_python_files(project.root, [self.package_rel]):
+            rel = os.path.relpath(full, project.root).replace(
+                os.sep, "/"
+            )
+            ctx = project.by_rel.get(rel)
+            if ctx is None:
+                try:
+                    with open(full, "r", encoding="utf-8") as fh:
+                        ctx = FileContext(full, rel, fh.read())
+                except (OSError, SyntaxError, ValueError):
+                    continue
+            for node in ast.walk(ctx.tree):
+                if (
+                    isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and self._FAMILY_RE.match(node.value)
+                ):
+                    mentioned.add(node.value)
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                fn = node.func
+                fn_name = (
+                    fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None
+                )
+                if fn_name not in self._REGISTER_FUNCS:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    if self._FAMILY_RE.match(arg.value):
+                        literals.setdefault(
+                            arg.value, (rel, arg.lineno)
+                        )
+                elif isinstance(arg, ast.JoinedStr):
+                    pieces: List[str] = []
+                    for part in arg.values:
+                        if isinstance(part, ast.Constant) and isinstance(
+                            part.value, str
+                        ):
+                            pieces.append(re.escape(part.value))
+                        else:
+                            pieces.append("[a-z0-9_]+")
+                    raw = "".join(pieces)
+                    if raw.startswith("keystone_"):
+                        patterns.append((
+                            re.compile(f"^{raw}$"), raw, rel,
+                            arg.lineno,
+                        ))
+        return literals, patterns, mentioned
+
+    def _readme_rows(
+        self, project: Project
+    ) -> Tuple[Optional[Dict[str, int]], int]:
+        path = os.path.join(project.root, self.readme_rel)
+        if not os.path.exists(path):
+            return None, 1
+        with open(path, "r", encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+        start = None
+        for i, line in enumerate(lines, start=1):
+            if self.table_heading in line:
+                start = i
+                break
+        if start is None:
+            return None, 1
+        rows: Dict[str, int] = {}
+        for i in range(start, len(lines) + 1):
+            line = lines[i - 1]
+            if i > start and (
+                line.startswith("#") or line.startswith("**")
+            ):
+                break  # next section/paragraph heading ends the table
+            m = self._README_ROW_RE.match(line)
+            if m:
+                rows[m.group(1)] = i
+        return rows, start
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        readme_rel = self.readme_rel.replace(os.sep, "/")
+        literals, patterns, mentioned = self._registered(project)
+        if not literals and not patterns:
+            return  # project without a metrics plane
+        rows, table_line = self._readme_rows(project)
+        if rows is None:
+            yield Finding(
+                rule=self.name, path=readme_rel, line=1, col=0,
+                message=(
+                    f"no '{self.table_heading}' table found in README "
+                    "— the exported families must be documented where "
+                    "operators look for them"
+                ),
+            )
+            return
+        for family, (rel, line) in sorted(literals.items()):
+            if family not in rows:
+                yield Finding(
+                    rule=self.name, path=readme_rel, line=table_line,
+                    col=0,
+                    message=(
+                        f"registered metric family `{family}` "
+                        f"({rel}:{line}) missing from the README "
+                        "metric-family catalog table"
+                    ),
+                )
+        for pattern, raw, rel, line in sorted(
+            patterns, key=lambda p: (p[1], p[2])
+        ):
+            if not any(pattern.match(r) for r in rows):
+                yield Finding(
+                    rule=self.name, path=rel, line=line, col=0,
+                    message=(
+                        f"f-string-registered family `{raw}` matches "
+                        "no row of the README metric-family catalog "
+                        "table — document each concrete family it "
+                        "expands to"
+                    ),
+                )
+        for family, line in sorted(rows.items()):
+            if family in literals or family in mentioned:
+                continue
+            if any(p.match(family) for p, _, _, _ in patterns):
+                continue
+            yield Finding(
+                rule=self.name, path=readme_rel, line=line, col=0,
+                message=(
+                    f"README catalogs metric family `{family}` that "
+                    "nothing in the package registers"
+                ),
+            )
+
+
 # -- registry ---------------------------------------------------------------
 
 ALL_RULES = (
@@ -819,6 +1018,7 @@ ALL_RULES = (
     AbsentNotZeroRule,
     HotPathHostSyncRule,
     FaultPointDriftRule,
+    MetricFamilyDriftRule,
 )
 
 

@@ -54,6 +54,14 @@ gateway frontend (``observability/httpd.py``). Routes:
   TRUE fleet p99, not a quantile of quantiles). Replicas that can't
   answer the on-demand scrape contribute their last probe's cached
   body instead.
+- ``GET /attributionz`` — the FLEET-TRUTH per-model device-cost
+  ledger: the federated scrape's ``keystone_attr_*{model}`` samples
+  (identical model labels across replicas sum) rebuilt into the same
+  document each replica serves (``observability/attribution.py``).
+- ``GET /driftz`` — fleet drift: every replica's
+  ``keystone_drift_score{model}`` off the federated scrape (the gauge
+  MAX-merges — the worst replica's drift is the fleet's); re-plan
+  recommendations stay on each replica's own ``/driftz``.
 - ``GET /slz`` — burn rates of the router's fleet-wide latency SLO
   (``Slo.latency_from_buckets`` over the merged replica buckets) when
   one is declared, alongside any replica-local monitors in-process.
@@ -261,6 +269,14 @@ class _RouterHandler(JsonHandler):
                 self._send(
                     200, body.encode("utf-8"), prometheus.CONTENT_TYPE
                 )
+            elif path == "/attributionz":
+                self._send_json(
+                    self.server.attributionz(), indent=1  # type: ignore[attr-defined]
+                )
+            elif path == "/driftz":
+                self._send_json(
+                    self.server.driftz(), indent=1  # type: ignore[attr-defined]
+                )
             elif path == "/slz":
                 self._send_json(slo_mod.slz_status(), indent=1)
             elif path == "/tracez":
@@ -300,7 +316,8 @@ class _RouterHandler(JsonHandler):
                     404,
                     "not found; try /predict /predict/<model> "
                     "/registerz /deregisterz /fleetz /readyz /healthz "
-                    "/metrics /slz /tracez /debugz /chaosz\n",
+                    "/metrics /attributionz /driftz /slz /tracez "
+                    "/debugz /chaosz\n",
                 )
         except Exception as e:
             logger.exception("router GET error for %s", self.path)
@@ -935,6 +952,50 @@ class RouterServer(BackgroundServer):
             [own] + self.fleet.fresh_scrapes(), on_conflict="drop"
         )
 
+    def attributionz(self, top_k: int = 10) -> Dict:
+        """The FLEET-TRUTH ``/attributionz``: the per-model cost-ledger
+        document rebuilt from the federated scrape, so identical model
+        labels across replicas have already SUMMED — the totals are the
+        fleet's, not this process's."""
+        from keystone_tpu.observability.attribution import (
+            attribution_from_samples,
+        )
+
+        return attribution_from_samples(
+            prometheus.parse_samples(self.federated_metrics()),
+            top_k=top_k,
+        )
+
+    def driftz(self) -> Dict:
+        """The fleet ``/driftz``: every replica's
+        ``keystone_drift_score{model}`` off the federated scrape (the
+        gauge MAX-merges — the worst replica's drift IS the fleet's).
+        Re-plan recommendations stay replica-local (each replica's
+        ``/driftz`` owns its zoo's plan); this surface names who is
+        drifting fleet-wide."""
+        from keystone_tpu.observability.drift import DEFAULT_THRESHOLD
+
+        scores: Dict[str, float] = {}
+        for name, labels, value in prometheus.parse_samples(
+            self.federated_metrics()
+        ):
+            if name != "keystone_drift_score":
+                continue
+            model = labels.get("model")
+            if model is not None:
+                scores[model] = max(scores.get(model, value), value)
+        return {
+            "threshold": DEFAULT_THRESHOLD,
+            "scores": {m: round(s, 4) for m, s in sorted(scores.items())},
+            "drifted": sorted(
+                m for m, s in scores.items() if s > DEFAULT_THRESHOLD
+            ),
+            "note": (
+                "federated MAX of keystone_drift_score per model; "
+                "re-plan recommendations live on each replica's /driftz"
+            ),
+        }
+
     def fleetz(self) -> Dict:
         """The ``/fleetz`` document: router identity + the roster."""
         doc = self.fleet.roster()
@@ -973,6 +1034,8 @@ class RouterServer(BackgroundServer):
         httpd.chaos_routes = self.chaos_routes
         httpd.federated_metrics = self.federated_metrics
         httpd.fleetz = self.fleetz
+        httpd.attributionz = self.attributionz
+        httpd.driftz = self.driftz
         httpd.router_name = self.name
         httpd.request_log = self.request_log
         httpd.write_request_log = self.write_request_log
@@ -1095,8 +1158,8 @@ def main(argv=None) -> int:
     print(
         f"router: {server.url()} (POST /predict, POST /registerz, "
         "POST /deregisterz, GET /fleetz, GET /readyz, GET /metrics, "
-        "GET /slz, GET /tracez, GET /debugz?trace_id=, "
-        "GET|POST /chaosz)",
+        "GET /attributionz, GET /driftz, GET /slz, GET /tracez, "
+        "GET /debugz?trace_id=, GET|POST /chaosz)",
         flush=True,
     )
     stop = threading.Event()

@@ -33,6 +33,18 @@ A stdlib ``http.server`` on a background daemon thread, following the
   null when serving on spec flags) next to every model's ACTUAL shape
   (resident/cold, lanes, buckets, shared-prefix membership) — the
   plan-vs-actual audit surface of ``--optimize``.
+- ``GET /attributionz`` — zoo mode only: the per-model device-cost
+  ledger (``observability/attribution.py``): device-seconds share,
+  modeled-FLOP share, seconds-per-GFLOP, goodput fraction, staging
+  bytes, and a top-k table — shared-prefix (CSE) windows fair-split
+  so per-model totals sum exactly to engine totals.
+- ``GET /driftz`` — zoo mode only: live-vs-plan workload drift
+  (``observability/drift.py``): per-model PSI of the windowed live
+  request-size histogram against the applied plan's assumed one,
+  plus — once any model trips the threshold — a RECOMMENDATION-ONLY
+  re-plan diff (``plan_placement`` re-run on live profiles; applying
+  it stays an operator decision). Each POST /predict observes its
+  instance count as one live size sample.
 - ``GET /readyz`` — 200 while the gateway admits, 503 once draining.
   READINESS, not liveness: the admin endpoint's ``/healthz`` answers
   "is the process up", this answers "should the load balancer route
@@ -242,6 +254,26 @@ class _Handler(JsonHandler):
                     )
                 else:
                     self._send_json(self.zoo.planz(), indent=1)
+            elif path == "/attributionz":
+                if self.zoo is None:
+                    self._send_error_json(
+                        404, "no_zoo",
+                        detail="started without --zoo; /attributionz "
+                               "reports the per-model device-cost "
+                               "ledger",
+                    )
+                else:
+                    self._send_json(self.zoo.attributionz(), indent=1)
+            elif path == "/driftz":
+                if self.zoo is None:
+                    self._send_error_json(
+                        404, "no_zoo",
+                        detail="started without --zoo; /driftz reports "
+                               "live-vs-plan workload drift and the "
+                               "re-plan recommendation",
+                    )
+                else:
+                    self._send_json(self.zoo.driftz(), indent=1)
             elif path == "/slz":
                 self._send_json(slo_mod.slz_status(), indent=1)
             elif path == "/debugz":
@@ -295,8 +327,9 @@ class _Handler(JsonHandler):
                 self._send_text(
                     404,
                     "not found; try /predict /predict/<model> /planz "
-                    "/readyz /healthz /metrics /slz /debugz /tracez "
-                    "/profilez /chaosz /lifecyclez\n",
+                    "/attributionz /driftz /readyz /healthz /metrics "
+                    "/slz /debugz /tracez /profilez /chaosz "
+                    "/lifecyclez\n",
                 )
         except Exception as e:
             logger.exception("gateway GET error for %s", self.path)
@@ -636,6 +669,12 @@ class _Handler(JsonHandler):
             "post_seq": next_post_seq(),
             "model": model_id,
         }
+        if zoo is not None:
+            # one drift observation per POST: the request's SIZE is its
+            # instance count — the same unit the placement planner's
+            # expected-size histograms are drawn in, so live-vs-plan
+            # PSI (observability/drift.py) compares like with like
+            zoo.observe_request(model_id, len(examples))
         # admit every instance BEFORE waiting on any: concurrent
         # instances coalesce into shared micro-batch windows. Every
         # instance of one POST shares the POST's trace id — the span
@@ -1069,13 +1108,19 @@ def main(argv=None) -> int:
 
             # plan BEFORE hosting: profiles(build=True) materializes
             # params (cheap, host memory) so params_nbytes is measured
-            # not guessed; hosting then happens under the plan
-            zoo.plan = plan_placement(
-                zoo.profiles(build=True),
-                ChipBudget(
-                    hbm_bytes=chip_hbm_bytes(),
-                    n_chips=len(jax.devices()),
-                ),
+            # not guessed; hosting then happens under the plan.
+            # apply_plan (not a bare assignment) also pins each
+            # profile's histogram as the drift-detector baseline and
+            # keeps the budget for /driftz re-plan audits
+            profiles = zoo.profiles(build=True)
+            budget = ChipBudget(
+                hbm_bytes=chip_hbm_bytes(),
+                n_chips=len(jax.devices()),
+            )
+            zoo.apply_plan(
+                plan_placement(profiles, budget),
+                budget=budget,
+                profiles=profiles,
             )
             print(
                 json.dumps({"plan": zoo.plan.to_dict()}), flush=True
@@ -1233,7 +1278,8 @@ def main(argv=None) -> int:
         flush=True,
     )
     zoo_routes = (
-        "POST /predict/<model>, GET /planz, " if zoo is not None else ""
+        "POST /predict/<model>, GET /planz, GET /attributionz, "
+        "GET /driftz, " if zoo is not None else ""
     )
     lifecycle_routes = (
         "POST /feedback, GET|POST /lifecyclez, "

@@ -121,7 +121,11 @@ def build_parser() -> argparse.ArgumentParser:
     wl.add_argument("--alpha", type=float, default=1.5,
                     help="pareto arrival tail index (> 1)")
     wl.add_argument("--size-mix", default="1:1.0", metavar="R:W,...",
-                    help="instance-count mixture, e.g. 1:0.8,4:0.2")
+                    help="instance-count mixture, e.g. 1:0.8,4:0.2 — "
+                    "replaying a SHIFTED mixture against a planned "
+                    "--zoo gateway is the drift-detector drill: "
+                    "keystone_drift_score rises and /driftz ships a "
+                    "re-plan recommendation")
     wl.add_argument("--deadline-ms", type=float, default=None)
     wl.add_argument("--deadline-sigma", type=float, default=0.0,
                     help="lognormal jitter on --deadline-ms")

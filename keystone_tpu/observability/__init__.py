@@ -32,6 +32,14 @@ from keystone_tpu.observability.admin import (
     start_admin_server,
     stop_admin_server,
 )
+from keystone_tpu.observability.attribution import (
+    AttributionLedger,
+    EngineAttribution,
+    RowClaimQueue,
+    attribution_document,
+    attribution_from_samples,
+)
+from keystone_tpu.observability.drift import DriftDetector, psi
 from keystone_tpu.observability.device import (
     DeviceMemorySampler,
     compiled_cost_model,
@@ -73,8 +81,15 @@ from keystone_tpu.observability.tracing import (
 
 __all__ = [
     "AdminServer",
+    "AttributionLedger",
     "DEFAULT_HISTOGRAM_BUCKETS",
     "DeviceMemorySampler",
+    "DriftDetector",
+    "EngineAttribution",
+    "RowClaimQueue",
+    "attribution_document",
+    "attribution_from_samples",
+    "psi",
     "compiled_cost_model",
     "device_memory_stats",
     "device_table",

@@ -27,6 +27,10 @@ install, nothing running unless ``AdminServer.start()`` (or the
   ``?seconds=N`` of live traffic and list the capture directory
   (Perfetto/XProf); one capture at a time — concurrent requests get
   409 (``observability/profilez.py``)
+- ``GET /attributionz`` -> the per-model device-cost ledger document
+  rebuilt from this registry's ``keystone_attr_*`` samples
+  (``observability/attribution.py``); empty when no ledger publishes
+  here
 
 Starting the endpoint also starts the device-truth side of the plane:
 the detected device table rides in ``/varz``'s build block and as the
@@ -222,11 +226,26 @@ class _Handler(JsonHandler):
                     q.get("seconds", [None])[0]
                 )
                 self._send_json(doc, code=code, indent=1)
+            elif url.path == "/attributionz":
+                # the admin endpoint holds a registry, not a zoo, so
+                # the ledger document is rebuilt from this registry's
+                # own keystone_attr_* samples — the same reconstruction
+                # the fleet router applies to its federated scrape
+                from keystone_tpu.observability.attribution import (
+                    attribution_from_samples,
+                )
+
+                samples = prometheus.parse_samples(
+                    prometheus.render(registry.collect())
+                )
+                self._send_json(
+                    attribution_from_samples(samples), indent=1
+                )
             else:
                 self._send_text(
                     404,
                     "not found; try /metrics /varz /healthz /tracez "
-                    "/slz /debugz /profilez\n",
+                    "/slz /debugz /profilez /attributionz\n",
                 )
         except Exception as e:  # a broken collector must not kill the
             # serving thread — report it to the scraper instead

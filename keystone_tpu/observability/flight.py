@@ -98,7 +98,7 @@ class FlightRecord:
     """One captured request: identity, verdict, and the span tree."""
 
     trace_id: str
-    reason: str  # "slo_breach" | "error"
+    reason: str  # "slo_breach" | "error" | "drift"
     captured_at: float  # epoch seconds
     duration_s: Optional[float]
     attrs: Dict[str, Any]

@@ -291,6 +291,10 @@ MERGE_MAX_FAMILIES = frozenset({
     "keystone_serving_padding_efficiency",
     "keystone_slo_burn_rate",
     "keystone_gateway_slo_pressure",
+    # drift is a divergence score, not a quantity: the worst replica's
+    # drift is the fleet's drift (two replicas each at 0.3 are not a
+    # fleet at 0.6)
+    "keystone_drift_score",
 })
 
 

@@ -1572,6 +1572,341 @@ def bench_zoo(
     )
 
 
+def bench_attribution_drift(
+    emit,
+    img: int = 16,
+    hidden: int = 64,
+    depth: int = 2,
+    buckets: Sequence[int] = (2, 8, 32),
+    n_per_model: int = 40,
+    n_threads: int = 4,
+    base_mix: str = "1:0.8,2:0.2",
+    shift_mix: str = "24:1.0",
+    max_p99_ratio: float = 1.05,
+    sum_tolerance: float = 1e-6,
+) -> None:
+    """``serving_attribution_drift`` — the attribution & drift plane
+    end-to-end: a two-model zoo (CSE-shared featurize prefix, so the
+    fair-split rule is actually exercised) planned against a small-size
+    mixture, driven through a MID-RUN WORKLOAD SHIFT — ``alpha``'s
+    request sizes swap from ``base_mix`` to ``shift_mix`` (loadgen's
+    size-mixture grammar) while ``beta`` stays on the planned mixture.
+
+    Gates (raise, not assert):
+
+    - **sum invariant**: per-model ledger totals
+      (``observability/attribution.py``) sum to the engine-side
+      counters — goodput/padded rows, dispatches, modeled FLOPs, H2D
+      bytes, completion-timed device seconds — within
+      ``sum_tolerance`` relative, CSE fair-split windows included;
+    - **drift selectivity**: after the shift, the PSI score trips the
+      threshold for ``alpha`` ONLY (``beta`` scores but stays under),
+      and nothing is flagged before the shift;
+    - **re-plan audit**: ``/driftz`` carries a non-empty
+      recommendation whose proposed buckets for the shifted model move
+      toward the new dominant size (the smallest bucket covering the
+      shifted size strictly tightens — the forced top bucket is pinned
+      at the spec cap, so growth shows up as better coverage below
+      it);
+    - **overhead**: client-observed p99 with attribution attached
+      <= ``max_p99_ratio`` x an identical zoo with the bindings
+      detached, with bounded re-measures of both sides absorbing
+      scheduler jitter (same posture as the router trace-overhead
+      row)."""
+    from keystone_tpu.loadgen.trace import parse_size_mix
+    from keystone_tpu.serving.featurize import build_featurize_pipeline
+    from keystone_tpu.zoo import (
+        BuiltModel, ModelRegistry, ModelSpec, ModelZoo,
+    )
+    from keystone_tpu.zoo.optimizer import ChipBudget, plan_placement
+
+    featurize, feat_d = build_featurize_pipeline(img=img)
+    heads = {
+        mid: build_pipeline(
+            d=feat_d, hidden=hidden, depth=depth, seed=seed
+        )
+        for mid, seed in (("alpha", 1), ("beta", 2))
+    }
+    model_ids = tuple(heads)
+    warm = jnp.zeros((img, img, 3), jnp.uint8)
+    rng = np.random.default_rng(23)
+    pool = rng.integers(0, 256, (16, img, img, 3), dtype=np.uint8)
+
+    def build_zoo():
+        reg = ModelRegistry()
+        for i, (mid, head) in enumerate(heads.items()):
+            reg.register(ModelSpec(
+                model_id=mid,
+                build=(lambda h=head: BuiltModel(
+                    fitted=h, featurize=featurize
+                )),
+                buckets=buckets,
+                lanes=1,
+                input_dtype=np.uint8,
+                warmup_example=warm,
+                max_delay_ms=2.0,
+                # the planner's assumed mixture — what base_mix's live
+                # traffic matches and shift_mix's diverges from
+                expected_sizes={
+                    s: max(1, int(round(w * 100)))
+                    for s, w in parse_size_mix(base_mix)
+                },
+                default=(i == 0),
+            ))
+        return ModelZoo(reg, cse=True)
+
+    def sizes_from(mix_spec: str, n: int):
+        mix = parse_size_mix(mix_spec)
+        weights = np.asarray([w for _, w in mix], dtype=float)
+        return [
+            int(s) for s in rng.choice(
+                [s for s, _ in mix], size=n, p=weights / weights.sum()
+            )
+        ]
+
+    def schedule_for(mix_by_model):
+        requests = []
+        for mid, mix_spec in mix_by_model.items():
+            requests.extend(
+                (mid, s) for s in sizes_from(mix_spec, n_per_model)
+            )
+        rng.shuffle(requests)
+        return requests
+
+    def drive(zoo, schedule):
+        """Run one phase: per request, one drift observation + ``size``
+        admitted instances; returns per-request client latencies."""
+        latencies = [None] * len(schedule)
+        errors = []
+
+        def client(tid):
+            try:
+                for i in range(tid, len(schedule), n_threads):
+                    mid, size = schedule[i]
+                    zoo.observe_request(mid, size)
+                    t0 = time.perf_counter()
+                    futs = [
+                        zoo.predict(pool[j % len(pool)], mid)
+                        for j in range(size)
+                    ]
+                    for f in futs:
+                        f.result(timeout=120)
+                    latencies[i] = time.perf_counter() - t0
+            except Exception as e:
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=client, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(
+                f"attribution bench client failed: {errors[0]!r}"
+            ) from errors[0]
+        return [lat for lat in latencies if lat is not None]
+
+    def p99(latencies):
+        return float(np.percentile(np.asarray(latencies), 99))
+
+    def gateways_of(zoo):
+        return {id(zoo.gateway_for(m)): zoo.gateway_for(m)
+                for m in model_ids}.values()
+
+    def engine_totals(zoo):
+        out = {
+            "goodput_rows": 0.0, "padded_rows": 0.0,
+            "dispatches": 0.0, "device_flops": 0.0,
+            "h2d_bytes": 0.0, "device_seconds": 0.0,
+        }
+        for gw in gateways_of(zoo):
+            for lane in gw.pool.lanes:
+                m = lane.engine.metrics
+                out["goodput_rows"] += m.examples.total
+                out["padded_rows"] += m.padded_rows.total
+                out["dispatches"] += m.dispatches.total
+                out["device_flops"] += m.device_flops.total
+                out["h2d_bytes"] += m.h2d_bytes.total
+                out["device_seconds"] += (
+                    m.dispatch_latency.snapshot()["total"]
+                )
+        return out
+
+    base_schedule = schedule_for({m: base_mix for m in model_ids})
+    shift_schedule = schedule_for(
+        {"alpha": shift_mix, "beta": base_mix}
+    )
+
+    zoo = build_zoo()
+    try:
+        zoo.host()
+        profiles = zoo.profiles(build=True)
+        budget = ChipBudget(lane_budget=len(model_ids))
+        zoo.apply_plan(
+            plan_placement(profiles, budget),
+            budget=budget, profiles=profiles,
+        )
+        old_buckets = {
+            m: zoo.plan.placement_for(m).buckets for m in model_ids
+        }
+        drive(zoo, base_schedule)  # matches the plan: nothing drifts
+        pre_shift = zoo.driftz()
+        on_latencies = drive(zoo, shift_schedule)
+        doc = zoo.driftz()
+        attr = zoo.attributionz()
+        eng = engine_totals(zoo)
+        led = zoo.attribution.totals()
+    finally:
+        zoo.close()
+
+    # -- gate 1: the sum invariant (CSE fair-split included) ---------------
+    rel_errs = {}
+    for field, eng_total in eng.items():
+        led_total = led[field]
+        rel = (
+            abs(eng_total - led_total) / abs(eng_total)
+            if eng_total else abs(led_total)
+        )
+        rel_errs[field] = rel
+        if rel > sum_tolerance:
+            raise RuntimeError(
+                f"attribution {field} totals diverge: engines "
+                f"{eng_total} vs ledger {led_total} "
+                f"({rel:.2e} rel > {sum_tolerance:.0e}) — per-model "
+                "charges must sum exactly to engine totals"
+            )
+    # -- gate 2: drift fires on the shifted model only ---------------------
+    if pre_shift["drifted"]:
+        raise RuntimeError(
+            f"models {pre_shift['drifted']} flagged as drifted while "
+            "traffic still matched the plan's mixture"
+        )
+    scores = doc["scores"]
+    if "alpha" not in doc["drifted"]:
+        raise RuntimeError(
+            f"the shifted model never tripped the PSI threshold "
+            f"(scores {scores}, threshold {doc['threshold']}) — "
+            f"{base_mix} -> {shift_mix} is a full population swap"
+        )
+    if "beta" in doc["drifted"]:
+        raise RuntimeError(
+            f"beta flagged as drifted (scores {scores}) though its "
+            "mixture never changed — drift must be per-model, not "
+            "engine-wide"
+        )
+    if "beta" not in scores:
+        raise RuntimeError(
+            "beta produced no PSI score despite a baseline and "
+            f"{n_per_model} windowed observations"
+        )
+    # -- gate 3: the re-plan audit -----------------------------------------
+    rec = doc["recommendation"]
+    if not rec or not rec.get("changes"):
+        raise RuntimeError(
+            f"drift tripped but /driftz carries no re-plan "
+            f"recommendation (got {rec!r})"
+        )
+    if "alpha" not in rec["changes"]:
+        raise RuntimeError(
+            f"re-plan changed {sorted(rec['changes'])} but not the "
+            "shifted model — the recommendation must follow the drift"
+        )
+    proposed = {
+        p["model"]: tuple(p["buckets"])
+        for p in rec["proposed_plan"]["placements"]
+    }
+    shift_size = max(s for s, _ in parse_size_mix(shift_mix))
+
+    def covering(bucket_set):
+        # what the shifted size actually pays under this bucket set
+        # (sizes over the top bucket chunk through it waste-free)
+        fits = [b for b in bucket_set if b >= shift_size]
+        return min(fits) if fits else max(bucket_set)
+
+    if covering(proposed["alpha"]) >= covering(old_buckets["alpha"]):
+        raise RuntimeError(
+            f"shifted model's proposed buckets {proposed['alpha']} "
+            f"don't cover size {shift_size} any tighter than the "
+            f"applied plan's {old_buckets['alpha']} though live "
+            f"sizes moved from {base_mix} to {shift_mix} — the "
+            "re-plan is not directionally correct"
+        )
+    # -- gate 4: attribution overhead --------------------------------------
+    def measure_off():
+        zoo_off = build_zoo()
+        try:
+            zoo_off.host()
+            for gw in gateways_of(zoo_off):
+                for lane in gw.pool.lanes:
+                    # identical serving shape, ledger mirror detached:
+                    # the A/B isolates the binding's hot-path cost
+                    lane.engine.metrics.attach_attribution(None)
+            drive(zoo_off, base_schedule)  # warm parity with the on side
+            return p99(drive(zoo_off, shift_schedule))
+        finally:
+            zoo_off.close()
+
+    p99_on = p99(on_latencies)
+    p99_off = measure_off()
+    for _ in range(2):
+        if p99_on <= max_p99_ratio * p99_off:
+            break
+        # bounded re-measures (scheduler jitter on a loaded CI host
+        # dwarfs the binding's cost); best observed per side is final
+        zoo_on2 = build_zoo()
+        try:
+            zoo_on2.host()
+            drive(zoo_on2, base_schedule)
+            p99_on = min(p99_on, p99(drive(zoo_on2, shift_schedule)))
+        finally:
+            zoo_on2.close()
+        p99_off = min(p99_off, measure_off())
+    if p99_on > max_p99_ratio * p99_off:
+        raise RuntimeError(
+            f"attribution-on p99 {p99_on * 1e3:.1f} ms vs off "
+            f"{p99_off * 1e3:.1f} ms — "
+            f"{p99_on / p99_off:.3f}x exceeds {max_p99_ratio}x: the "
+            "ledger mirror is not allowed to tax the serving path"
+        )
+
+    emit(
+        "serving_attribution_drift",
+        scores.get("alpha"), "psi",
+        extra={
+            "scores": scores,
+            "threshold": doc["threshold"],
+            "drifted": doc["drifted"],
+            "base_mix": base_mix,
+            "shift_mix": shift_mix,
+            "attribution_rel_err_max": max(rel_errs.values()),
+            "attribution_totals": {
+                k: round(v, 6) for k, v in led.items()
+            },
+            "per_model_device_seconds": {
+                m: round(
+                    attr["models"][m]["device_seconds"], 6
+                )
+                for m in attr["models"]
+            },
+            "replan_changed_models": sorted(rec["changes"]),
+            "buckets_before": {
+                m: list(b) for m, b in old_buckets.items()
+            },
+            "buckets_proposed": {
+                m: list(b) for m, b in proposed.items()
+            },
+            "p99_on_ms": round(p99_on * 1e3, 3),
+            "p99_off_ms": round(p99_off * 1e3, 3),
+            "p99_ratio": round(p99_on / p99_off, 3),
+            "max_p99_ratio": max_p99_ratio,
+            "requests_per_model_per_phase": n_per_model,
+        },
+    )
+
+
 def bench_sharded_vs_replicated(
     emit,
     sizes: Sequence[int] = (128, 256, 512),
@@ -2857,6 +3192,17 @@ def run_zoo_benches(emit) -> None:
     bench_zoo(emit)
 
 
+def run_attribution_benches(emit) -> None:
+    """The attribution & drift row alone (``--attribution-only``, what
+    ``bin/smoke-attribution.sh`` invokes): a two-model CSE zoo through
+    a mid-run size-mixture shift, gating the ledger sum invariant, PSI
+    selectivity, the re-plan audit, and the attribution-on/off p99
+    ratio. Owns its (small) pipeline shape — the row builds three
+    zoos for the A/B, so the generic bench dims would turn it into a
+    compile benchmark."""
+    bench_attribution_drift(emit)
+
+
 def run_lifecycle_benches(emit) -> None:
     """The online-lifecycle row alone (``--lifecycle-only``, what
     ``bin/smoke-rollout.sh`` invokes): streaming refit → shadow →
@@ -2890,6 +3236,7 @@ def run_serving_benches(
     shard: bool = False,
     zoo: bool = False,
     lifecycle: bool = False,
+    attribution: bool = False,
 ) -> None:
     fitted = build_pipeline(d, hidden, depth)
     bench_cold_vs_warm(emit, fitted, buckets, d)
@@ -2938,6 +3285,8 @@ def run_serving_benches(
         run_zoo_benches(emit)
     if lifecycle:
         run_lifecycle_benches(emit)
+    if attribution:
+        run_attribution_benches(emit)
     if autoscale:
         # its own (smaller) pipeline: scale-up reaction time includes
         # per-replica warmup, which the default bench shape would
@@ -3053,6 +3402,19 @@ def main(argv=None) -> int:
     ap.add_argument("--lifecycle-only", action="store_true",
                     help="run ONLY the online-lifecycle row (what "
                     "bin/smoke-rollout.sh invokes)")
+    ap.add_argument("--attribution", action="store_true",
+                    help="also run the attribution & drift row "
+                    "(serving_attribution_drift): a two-model CSE "
+                    "zoo through a mid-run size-mixture shift, "
+                    "asserting per-model ledger totals sum to engine "
+                    "totals (<=1e-6 rel), PSI drift fires on the "
+                    "shifted model only, the /driftz re-plan "
+                    "recommendation is non-empty and directionally "
+                    "correct, and attribution-on p99 <= 1.05x off "
+                    "(~60s)")
+    ap.add_argument("--attribution-only", action="store_true",
+                    help="run ONLY the attribution & drift row (what "
+                    "bin/smoke-attribution.sh invokes)")
     ap.add_argument("--shard", action="store_true",
                     help="also run the model-axis A/B "
                     "(serving_sharded_vs_replicated): the same model "
@@ -3113,6 +3475,8 @@ def main(argv=None) -> int:
             run_zoo_benches(emit)
         elif args.lifecycle_only:
             run_lifecycle_benches(emit)
+        elif args.attribution_only:
+            run_attribution_benches(emit)
         elif args.autoscale_only:
             run_autoscale_benches(emit)
         elif args.fleet_only:
@@ -3136,6 +3500,7 @@ def main(argv=None) -> int:
                 shard=args.shard,
                 zoo=args.zoo,
                 lifecycle=args.lifecycle,
+                attribution=args.attribution,
             )
 
     if args.profile_dir:

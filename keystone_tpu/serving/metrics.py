@@ -142,8 +142,19 @@ class ServingMetrics:
         ] = collections.deque()  # guarded-by: _lock
         self._lock = threading.Lock()
         self._t0 = self._clock()
+        # optional per-model attribution binding
+        # (observability/attribution.EngineAttribution): every dispatch
+        # fact recorded here is mirrored into the model-labeled ledger,
+        # fair-split over shared engines. None (the default) keeps the
+        # hot path untouched — one attribute check per dispatch.
+        self._attribution = None
 
     # -- engine-side hooks -------------------------------------------------
+
+    def attach_attribution(self, binding) -> None:
+        """Mirror this engine's dispatch facts into a per-model cost
+        ledger (``observability/attribution.EngineAttribution``)."""
+        self._attribution = binding
 
     def record_trace(self, bucket: int) -> None:
         self.compiles.inc(bucket)
@@ -176,6 +187,10 @@ class ServingMetrics:
             self.device_flops.inc(None, flops)
         if seconds is not None:
             self.dispatch_latency.record(seconds)
+        if self._attribution is not None:
+            self._attribution.on_dispatch(
+                bucket, n_valid, padded, flops, seconds, h2d_bytes
+            )
         now = self._clock()
         with self._lock:
             self._rate_events.append((now, n_valid, padded, flops))
@@ -191,6 +206,8 @@ class ServingMetrics:
         """Completion-timed dispatch wall time, recorded at the sync
         point where the dispatched results became ready."""
         self.dispatch_latency.record(seconds)
+        if self._attribution is not None:
+            self._attribution.on_complete(seconds)
 
     # -- device-truth hooks (engine warmup / observability.device) ---------
 

@@ -35,10 +35,12 @@ persistent XLA compile cache) until the fingerprint covers head sets.
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
+from keystone_tpu.observability import device as device_obs
+from keystone_tpu.observability.attribution import RowClaimQueue
 from keystone_tpu.serving.engine import CompiledPipeline
 from keystone_tpu.serving.featurize import featurize_token
 
@@ -112,6 +114,68 @@ class SharedPrefixEngine(CompiledPipeline):
             aot_store=None,
             **kwargs,
         )
+        # -- per-model attribution inputs (observability/attribution) --
+        # row claims enqueued at submit time (by the zoo, or directly
+        # when the engine is driven standalone), drained FIFO per
+        # dispatched window; the zoo replaces this with a UNIT-level
+        # queue shared across lanes
+        self.claims = RowClaimQueue()
+        # bucket -> (prefix_flops, {model: head_flops}): the fair-split
+        # cost inputs, extracted best-effort at warmup
+        self._split_costs: Dict[int, Tuple[float, Dict[str, float]]] = {}
+
+    # -- attribution seams -------------------------------------------------
+
+    def claim_rows(self, model_id: str, rows: float) -> None:
+        """Declare that ``rows`` of upcoming window traffic belong to
+        ``model_id``."""
+        self.claims.claim(model_id, rows)
+
+    def drain_claims(self, n_valid: float) -> Dict[str, float]:
+        """Consume claims covering ``n_valid`` dispatched rows ->
+        ``{model: rows}`` (see ``RowClaimQueue.drain``)."""
+        return self.claims.drain(n_valid)
+
+    def split_cost_model(
+        self, bucket: int
+    ) -> Optional[Tuple[float, Dict[str, float]]]:
+        """``(prefix_flops, {model: head_flops})`` for one bucket
+        program, or None where extraction failed (the binding degrades
+        to pure row-share splitting)."""
+        return self._split_costs.get(bucket)
+
+    def _register_cost_model(
+        self, bucket: int, fn, staged, want_executable: bool = False
+    ):
+        """On top of the whole-program cost model, extract the SPLIT
+        one: the shared prefix lowered alone vs each head lowered over
+        the prefix's output aval. Same best-effort contract — a backend
+        reporting nothing leaves the split absent and attribution
+        degrades to row share."""
+        compiled = super()._register_cost_model(
+            bucket, fn, staged, want_executable=want_executable
+        )
+        try:
+            feat_run = self.featurize._batch_run
+            prefix_model = device_obs.compiled_cost_model(
+                jax.jit(feat_run).lower(staged)
+            )
+            prefix_flops = float(prefix_model.get("flops") or 0.0)
+            feat_aval = jax.eval_shape(feat_run, staged)
+            head_flops: Dict[str, float] = {}
+            for mid, head in self.heads.items():
+                head_model = device_obs.compiled_cost_model(
+                    jax.jit(head._batch_run).lower(feat_aval)
+                )
+                head_flops[mid] = float(head_model.get("flops") or 0.0)
+            if prefix_flops > 0 and any(head_flops.values()):
+                self._split_costs[bucket] = (prefix_flops, head_flops)
+        except Exception:
+            logger.debug(
+                "no split cost model for shared bucket %d", bucket,
+                exc_info=True,
+            )
+        return compiled
 
     def _make_jit(self, bucket: int):
         feat_run = self.featurize._batch_run

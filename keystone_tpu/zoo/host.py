@@ -33,6 +33,7 @@ gateway/engine families under its own gateway name.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 import time
@@ -40,11 +41,21 @@ from concurrent.futures import CancelledError, Future
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from keystone_tpu.gateway.lifecycle import Gateway
+from keystone_tpu.observability.attribution import (
+    AttributionLedger,
+    EngineAttribution,
+    RowClaimQueue,
+    attribution_document,
+)
+from keystone_tpu.observability.drift import DriftDetector
 from keystone_tpu.serving import aot as aot_lib
 from keystone_tpu.zoo.cse import SharedPrefixEngine, featurize_groups
 from keystone_tpu.zoo.optimizer import (
+    ChipBudget,
     ModelProfile,
     PlacementPlan,
+    diff_plans,
+    plan_placement,
 )
 from keystone_tpu.zoo.registry import (
     BuiltModel,
@@ -95,11 +106,15 @@ class _Unit:
         gateway: Gateway,
         shared: bool,
         pinned: bool,
+        claims: Optional[RowClaimQueue] = None,
     ):
         self.ids = ids
         self.gateway = gateway
         self.shared = shared
         self.pinned = pinned
+        # shared units: the unit-level row-claim queue every lane
+        # engine's attribution binding drains from
+        self.claims = claims
         # LRU stamp. The owning ModelZoo holds ITS lock around every
         # touch()/read — the lock lives on the zoo, not this unit, so
         # the contract is prose rather than a guarded-by annotation.
@@ -144,6 +159,9 @@ class ModelZoo:
         self._closed = False
         # optional per-model online-lifecycle plane (attach_lifecycle)
         self.lifecycle = None
+        # the budget the applied plan was planned under (apply_plan /
+        # --optimize) — what the drift audit re-plans against
+        self.plan_budget: Optional[ChipBudget] = None
         from keystone_tpu.observability.registry import (
             get_global_registry,
         )
@@ -152,6 +170,14 @@ class ModelZoo:
             metrics_registry if metrics_registry is not None
             else get_global_registry()
         )
+        # the attribution & drift plane: every unit's engines charge
+        # the per-model cost ledger (keystone_attr_*{model}), and the
+        # drift detector scores live request-size mixtures against the
+        # applied plan's baselines (keystone_drift_score{model})
+        self.attribution = AttributionLedger()
+        self.attribution.register(reg)
+        self.drift = DriftDetector()
+        self.drift.register(reg)
         self._resident_g = reg.gauge(
             "keystone_zoo_resident",
             "1 when the model currently holds compiled engines "
@@ -340,6 +366,10 @@ class ModelZoo:
                 name=spec.model_id,
                 slo_latency_s=spec.slo_latency_s,
             )
+            for lane in gw.pool.lanes:
+                lane.engine.metrics.attach_attribution(
+                    EngineAttribution(self.attribution, ids)
+                )
             return _Unit(ids, gw, shared=False, pinned=pinned)
         # -- shared-prefix unit (CSE group) ----------------------------
         builts = {mid: self._built(mid) for mid in ids}
@@ -380,7 +410,21 @@ class ModelZoo:
             name=name,
             slo_latency_s=min(slos) if slos else None,
         )
-        return _Unit(ids, gw, shared=True, pinned=pinned)
+        # fair-split attribution: one UNIT-level claim queue shared by
+        # every lane, each lane's shared engine bound with its own
+        # prefix/head split cost model
+        claims = RowClaimQueue()
+        for lane in gw.pool.lanes:
+            engine = lane.engine
+            split_fn = getattr(engine, "split_cost_model", None)
+            lane.engine.metrics.attach_attribution(
+                EngineAttribution(
+                    self.attribution, ids,
+                    shares_fn=claims.drain,
+                    split_cost_fn=split_fn,
+                )
+            )
+        return _Unit(ids, gw, shared=True, pinned=pinned, claims=claims)
 
     # -- LRU eviction ------------------------------------------------------
 
@@ -459,6 +503,10 @@ class ModelZoo:
         synchronously like ``Gateway.predict``."""
         mid, _spec = self.resolve(model_id)
         unit = self._ensure_resident(mid)
+        if unit.shared and unit.claims is not None:
+            # claim BEFORE submit: the window this example coalesces
+            # into drains its membership from the same FIFO
+            unit.claims.claim(mid, 1)
         fut = unit.gateway.predict(
             example, deadline_ms=deadline_ms, trace_id=trace_id
         )
@@ -492,6 +540,13 @@ class ModelZoo:
             unit = self._units.get(unit_ids) or self._by_model[
                 members[0]
             ]
+            if unit.shared and unit.claims is not None:
+                # one window row serves every requested member: its
+                # ownership splits evenly among them (the shared
+                # prefix's fair-split input)
+                share = 1.0 / len(members)
+                for mid in members:
+                    unit.claims.claim(mid, share)
             fut = unit.gateway.predict(
                 example, deadline_ms=deadline_ms
             )
@@ -558,6 +613,108 @@ class ModelZoo:
             "+".join(u.ids): u.gateway.rebucket(force=force)
             for u in units
         }
+
+    # -- attribution & drift plane -----------------------------------------
+
+    def apply_plan(
+        self,
+        plan: PlacementPlan,
+        budget: Optional[ChipBudget] = None,
+        profiles: Optional[Sequence[ModelProfile]] = None,
+    ) -> None:
+        """Adopt a placement plan: future page-ins use its placements,
+        the budget is retained for the drift audit's re-plan, and each
+        planning profile's histogram is pinned as that model's DRIFT
+        BASELINE — the distribution the plan assumed, which is exactly
+        what live traffic is scored against."""
+        self.plan = plan
+        self.plan_budget = budget
+        for prof in profiles or ():
+            if prof.histogram:
+                self.drift.set_baseline(prof.model_id, prof.histogram)
+
+    def observe_request(
+        self, model_id: Optional[str], size: int
+    ) -> None:
+        """Feed one live request's row count into the drift detector
+        (the HTTP frontend calls this per /predict request with its
+        instance count; benches call it directly)."""
+        try:
+            mid, _spec = self.resolve(model_id)
+        except UnknownModel:
+            return
+        self.drift.observe(mid, size)
+
+    def live_profiles(self) -> List[ModelProfile]:
+        """Planning profiles with each histogram replaced by the drift
+        window's LIVE one (where live observations exist) — the
+        re-plan-on-drift input."""
+        out = []
+        for prof in self.profiles():
+            live = self.drift.live_histogram(prof.model_id)
+            if live:
+                prof = dataclasses.replace(prof, histogram=live)
+            out.append(prof)
+        return out
+
+    def _refresh_staging(self) -> None:
+        """Point-in-time per-model staging bytes: each unit's live
+        host staging pools (absent until a pipelined lane ran), split
+        evenly over the unit's members."""
+        with self._lock:
+            units = list(self._units.values())
+        seen = set()
+        for unit in units:
+            total = 0
+            have = False
+            for lane in unit.gateway.pool.lanes:
+                nbytes = lane.engine.metrics.staging_bytes
+                if nbytes is not None:
+                    total += nbytes
+                    have = True
+            share = total / len(unit.ids) if have else None
+            for mid in unit.ids:
+                self.attribution.set_staging_bytes(mid, share)
+                seen.add(mid)
+        for mid in self.registry.ids():
+            if mid not in seen:
+                self.attribution.set_staging_bytes(mid, None)
+
+    def attributionz(self, top_k: int = 10) -> Dict[str, Any]:
+        """The ``GET /attributionz`` document: the per-model ledger
+        with device-seconds shares, normalized unit cost, and the
+        top-k spender table."""
+        self._refresh_staging()
+        return attribution_document(self.attribution, top_k=top_k)
+
+    def driftz(self) -> Dict[str, Any]:
+        """The ``GET /driftz`` document: per-model PSI scores plus —
+        once any model crossed the threshold and a plan is applied —
+        the audit: ``plan_placement`` re-run on the LIVE profiles and
+        the diff of what would change. Recommendation-only; nothing is
+        auto-applied."""
+        doc = self.drift.document()
+        doc["plan_applied"] = self.plan is not None
+        recommendation = None
+        if doc["drifted"] and self.plan is not None:
+            budget = self.plan_budget or ChipBudget(
+                hbm_bytes=self.plan.hbm_budget_bytes,
+                lane_budget=self.plan.lane_budget,
+            )
+            try:
+                proposed = plan_placement(self.live_profiles(), budget)
+                recommendation = {
+                    "note": (
+                        "recommendation only — re-plan is never "
+                        "auto-applied"
+                    ),
+                    "changes": diff_plans(self.plan, proposed),
+                    "proposed_plan": proposed.to_dict(),
+                }
+            except Exception as e:  # audit must not 500 /driftz
+                recommendation = {"error": str(e)}
+        doc["recommendation"] = recommendation
+        return doc
 
     # -- planning inputs + status ------------------------------------------
 

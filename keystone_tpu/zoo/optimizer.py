@@ -286,11 +286,48 @@ def plan_placement(
     )
 
 
+def diff_plans(
+    old: Optional[PlacementPlan], new: PlacementPlan
+) -> Dict[str, Dict[str, Any]]:
+    """What the ``new`` plan would CHANGE relative to ``old`` — the
+    ``/driftz`` recommendation payload. Per model whose placement
+    differs, each changed field as ``{"from": ..., "to": ...}``; models
+    present on one side only diff against None. Pure like the planner:
+    an empty dict means the re-plan confirmed the applied placement."""
+    old_by = (
+        {p.model_id: p for p in old.placements} if old is not None else {}
+    )
+    new_by = {p.model_id: p for p in new.placements}
+    out: Dict[str, Dict[str, Any]] = {}
+    for mid in sorted(set(old_by) | set(new_by)):
+        a, b = old_by.get(mid), new_by.get(mid)
+        if a is None or b is None:
+            out[mid] = {
+                "placement": {
+                    "from": a.to_dict() if a is not None else None,
+                    "to": b.to_dict() if b is not None else None,
+                }
+            }
+            continue
+        changes: Dict[str, Any] = {}
+        for field, fa, fb in (
+            ("buckets", list(a.buckets), list(b.buckets)),
+            ("lanes", a.lanes, b.lanes),
+            ("sharded", a.sharded, b.sharded),
+        ):
+            if fa != fb:
+                changes[field] = {"from": fa, "to": fb}
+        if changes:
+            out[mid] = changes
+    return out
+
+
 __all__ = [
     "ChipBudget",
     "DEFAULT_PARAM_FRACTION",
     "ModelPlacement",
     "ModelProfile",
     "PlacementPlan",
+    "diff_plans",
     "plan_placement",
 ]

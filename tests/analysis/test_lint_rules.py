@@ -1,8 +1,9 @@
-"""Per-rule fixture snippets: each of the six rules proven to FIRE on
-its defect pattern and to STAY QUIET on the compliant twin. The
+"""Per-rule fixture snippets: each of the seven rules proven to FIRE
+on its defect pattern and to STAY QUIET on the compliant twin. The
 snippets are miniature versions of the real incidents the rules
 encode (tracer ring swap, build-under-pool-lock, chaos-row asserts,
-zero-stamped MFU, per-row delivery slicing, catalog drift)."""
+zero-stamped MFU, per-row delivery slicing, catalog drift, dark
+metric families)."""
 
 import textwrap
 
@@ -15,6 +16,7 @@ from keystone_tpu.analysis.rules import (
     FaultPointDriftRule,
     GuardedByRule,
     HotPathHostSyncRule,
+    MetricFamilyDriftRule,
     StrippableAssertRule,
 )
 
@@ -463,3 +465,114 @@ def test_drift_project_scan_survives_file_slices(tmp_path):
     assert [
         f for f in result.findings if f.rule == "fault-point-drift"
     ] == []
+
+
+# -- metric-family-drift ----------------------------------------------------
+
+
+def family_project(
+    tmp_path,
+    registered=("keystone_demo_hits_total", "keystone_demo_depth"),
+    fstring_field=None,
+    readme=("keystone_demo_hits_total", "keystone_demo_depth"),
+    with_table=True,
+):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    lines = ["reg = object()\n\n\ndef wire(reg):"]
+    for fam in registered:
+        lines.append(f'    reg.counter("{fam}", "help")')
+    if fstring_field:
+        lines.append(
+            f'    reg.gauge(f"keystone_demo_{{{fstring_field}}}_total",'
+            ' "help")'
+        )
+    if len(lines) == 1:
+        lines.append("    pass")
+    (pkg / "metrics.py").write_text("\n".join(lines) + "\n")
+    if with_table:
+        rows = "\n".join(f"| `{f}` | counter | doc |" for f in readme)
+        (tmp_path / "README.md").write_text(
+            "# demo\n\n**Metric-family catalog** — every exported "
+            "family:\n\n| family | kind | meaning |\n|---|---|---|\n"
+            + rows + "\n\n## Next\n"
+        )
+    else:
+        (tmp_path / "README.md").write_text("# demo\n\nno table here\n")
+    return MetricFamilyDriftRule(
+        readme_rel="README.md", package_rel="pkg"
+    )
+
+
+def run_family(tmp_path, rule, paths=("pkg",)):
+    result = run_analysis(str(tmp_path), list(paths), [rule])
+    return [
+        f for f in result.findings if f.rule == "metric-family-drift"
+    ]
+
+
+def test_family_quiet_when_code_and_readme_agree(tmp_path):
+    rule = family_project(tmp_path)
+    assert run_family(tmp_path, rule) == []
+
+
+def test_family_fires_on_undocumented_registration(tmp_path):
+    rule = family_project(tmp_path, readme=("keystone_demo_depth",))
+    fs = run_family(tmp_path, rule)
+    assert len(fs) == 1
+    assert "keystone_demo_hits_total" in fs[0].message
+    assert "missing from the README" in fs[0].message
+    assert fs[0].path == "README.md"
+
+
+def test_family_fires_on_phantom_readme_row(tmp_path):
+    rule = family_project(
+        tmp_path,
+        readme=(
+            "keystone_demo_hits_total", "keystone_demo_depth",
+            "keystone_demo_ghost",
+        ),
+    )
+    fs = run_family(tmp_path, rule)
+    assert len(fs) == 1
+    assert "nothing in the package registers" in fs[0].message
+
+
+def test_family_fires_when_table_missing_entirely(tmp_path):
+    rule = family_project(tmp_path, with_table=False)
+    fs = run_family(tmp_path, rule)
+    assert len(fs) == 1 and "no 'Metric-family catalog'" in fs[0].message
+
+
+def test_family_fstring_pattern_matches_rows(tmp_path):
+    # an f-string family covers every row its wildcard matches: the
+    # rows are neither phantom nor is the pattern unmatched
+    rule = family_project(
+        tmp_path,
+        registered=(),
+        fstring_field="field",
+        readme=(
+            "keystone_demo_device_seconds_total",
+            "keystone_demo_h2d_bytes_total",
+        ),
+    )
+    assert run_family(tmp_path, rule) == []
+
+
+def test_family_fstring_pattern_unmatched_fires(tmp_path):
+    rule = family_project(
+        tmp_path, registered=(), fstring_field="field", readme=()
+    )
+    fs = run_family(tmp_path, rule)
+    assert len(fs) == 1
+    assert "matches no row" in fs[0].message
+    assert fs[0].path == "pkg/metrics.py"
+
+
+def test_family_scan_survives_file_slices(tmp_path):
+    # slicing the analysis to one unrelated file must not hide the
+    # registrations in metrics.py — the scan reads the package from
+    # disk like the fault-point rule
+    rule = family_project(tmp_path)
+    (tmp_path / "pkg" / "other.py").write_text("x = 1\n")
+    assert run_family(tmp_path, rule, paths=("pkg/other.py",)) == []

@@ -198,6 +198,113 @@ def test_merge_expositions_rejects_bad_mode():
         merge_expositions([SCRAPE_A], on_conflict="ignore")
 
 
+# -- model-labeled families across replicas --------------------------------
+
+
+REPLICA_A_ZOO = """\
+# HELP keystone_attr_device_seconds_total device seconds charged per model
+# TYPE keystone_attr_device_seconds_total counter
+keystone_attr_device_seconds_total{model="alpha"} 2.5
+keystone_attr_device_seconds_total{model="beta"} 1.0
+keystone_attr_goodput_rows_total{model="alpha"} 100
+keystone_attr_goodput_rows_total{model="beta"} 40
+keystone_zoo_resident{model="alpha"} 1
+keystone_zoo_resident{model="beta"} 1
+keystone_zoo_pageins_total{model="alpha"} 1
+keystone_drift_score{model="alpha"} 0.4
+keystone_drift_score{model="beta"} 0.05
+"""
+
+# overlapping (alpha) AND distinct (gamma) model sets vs replica A
+REPLICA_B_ZOO = """\
+# TYPE keystone_attr_device_seconds_total counter
+keystone_attr_device_seconds_total{model="alpha"} 0.5
+keystone_attr_device_seconds_total{model="gamma"} 4.0
+keystone_attr_goodput_rows_total{model="alpha"} 20
+keystone_attr_goodput_rows_total{model="gamma"} 200
+keystone_zoo_resident{model="alpha"} 1
+keystone_zoo_resident{model="gamma"} 1
+keystone_zoo_pageins_total{model="alpha"} 2
+keystone_drift_score{model="alpha"} 0.1
+keystone_drift_score{model="gamma"} 0.3
+"""
+
+
+def _rows(body):
+    return {
+        (name, tuple(sorted(labels.items()))): value
+        for name, labels, value in parse_samples(body)
+    }
+
+
+def test_merge_expositions_model_label_sets_sum_per_model():
+    """Counters with the SAME model label sum across replicas; each
+    label set stays its own series — no cross-model bleed."""
+    rows = _rows(merge_expositions([REPLICA_A_ZOO, REPLICA_B_ZOO]))
+
+    def row(name, model):
+        return rows[(name, (("model", model),))]
+
+    # overlapping model: per-replica values sum
+    assert row("keystone_attr_device_seconds_total", "alpha") == 3.0
+    assert row("keystone_attr_goodput_rows_total", "alpha") == 120.0
+    assert row("keystone_zoo_pageins_total", "alpha") == 3.0
+    # distinct models: carried through verbatim, not blended
+    assert row("keystone_attr_device_seconds_total", "beta") == 1.0
+    assert row("keystone_attr_device_seconds_total", "gamma") == 4.0
+    assert row("keystone_attr_goodput_rows_total", "gamma") == 200.0
+    # residency is additive (replica count holding the model)
+    assert row("keystone_zoo_resident", "alpha") == 2.0
+    assert row("keystone_zoo_resident", "beta") == 1.0
+
+
+def test_merge_expositions_no_cross_model_bleed():
+    """The merged body must contain EXACTLY the union of the input
+    label sets per family — no invented models, none dropped."""
+    rows = _rows(merge_expositions([REPLICA_A_ZOO, REPLICA_B_ZOO]))
+    models = sorted(
+        labels[0][1]
+        for (name, labels), _ in rows.items()
+        if name == "keystone_attr_device_seconds_total"
+    )
+    assert models == ["alpha", "beta", "gamma"]
+    # beta only ever appeared on replica A: its value is A's alone
+    assert rows[
+        ("keystone_attr_goodput_rows_total", (("model", "beta"),))
+    ] == 40.0
+
+
+def test_merge_expositions_drift_score_takes_fleet_max():
+    """``keystone_drift_score`` is a divergence ratio, not a
+    quantity: the fleet's score per model is the WORST replica's, and
+    two replicas each under threshold must never sum into a
+    fabricated page."""
+    rows = _rows(merge_expositions([REPLICA_A_ZOO, REPLICA_B_ZOO]))
+    assert rows[("keystone_drift_score", (("model", "alpha"),))] == 0.4
+    assert rows[("keystone_drift_score", (("model", "beta"),))] == 0.05
+    assert rows[("keystone_drift_score", (("model", "gamma"),))] == 0.3
+
+
+def test_attribution_document_from_federated_scrape():
+    """The router's ``/attributionz`` path: federate, parse, rebuild —
+    per-model cells are fleet sums and the shares are computed over
+    the fleet totals."""
+    from keystone_tpu.observability.attribution import (
+        attribution_from_samples,
+    )
+
+    body = merge_expositions([REPLICA_A_ZOO, REPLICA_B_ZOO])
+    doc = attribution_from_samples(parse_samples(body))
+    assert set(doc["models"]) == {"alpha", "beta", "gamma"}
+    assert doc["models"]["alpha"]["device_seconds"] == 3.0
+    assert doc["totals"]["device_seconds"] == 8.0
+    assert doc["models"]["gamma"]["device_seconds_share"] == 0.5
+    assert math.isclose(
+        sum(m["device_seconds_share"] for m in doc["models"].values()),
+        1.0,
+    )
+
+
 # -- Slo.latency_from_buckets (the fleet-SLO read) -------------------------
 
 

@@ -1,0 +1,268 @@
+"""The per-model device-cost ledger: charge accumulation, the
+row-claim queue, CSE fair-split weights, the exact sum invariant
+(per-model charges sum to engine totals), document shapes, and the
+reconstruction from exported ``keystone_attr_*`` samples."""
+
+import math
+
+import pytest
+
+from keystone_tpu.observability.attribution import (
+    CELL_FIELDS,
+    AttributionLedger,
+    EngineAttribution,
+    RowClaimQueue,
+    attribution_document,
+    attribution_from_samples,
+)
+from keystone_tpu.observability.registry import MetricsRegistry
+
+
+# -- RowClaimQueue ---------------------------------------------------------
+
+
+def test_claim_queue_drains_fifo():
+    q = RowClaimQueue()
+    q.claim("a", 2)
+    q.claim("b", 3)
+    assert q.drain(2) == {"a": 2.0}
+    assert q.drain(3) == {"b": 3.0}
+    assert len(q) == 0
+
+
+def test_claim_queue_splits_partial_claims_across_windows():
+    # a 4-row claim split over two 2-row dispatch windows must charge
+    # 2 rows to each window, never 4 to the first
+    q = RowClaimQueue()
+    q.claim("a", 4)
+    assert q.drain(2) == {"a": 2.0}
+    assert q.drain(2) == {"a": 2.0}
+    assert q.drain(2) == {}
+
+
+def test_claim_queue_merges_same_model_within_a_window():
+    q = RowClaimQueue()
+    q.claim("a", 1)
+    q.claim("b", 1)
+    q.claim("a", 1)
+    assert q.drain(3) == {"a": 2.0, "b": 1.0}
+
+
+def test_claim_queue_fractional_claims():
+    # predict_many claims 1/len(members) per member — fractions must
+    # survive the FIFO intact
+    q = RowClaimQueue()
+    q.claim("a", 0.5)
+    q.claim("b", 0.5)
+    assert q.drain(1) == {"a": 0.5, "b": 0.5}
+
+
+# -- ledger charges + registry export --------------------------------------
+
+
+def test_ledger_charges_accumulate_and_total():
+    led = AttributionLedger()
+    led.charge("a", device_seconds=1.0, goodput_rows=4)
+    led.charge("a", device_seconds=0.5)
+    led.charge("b", goodput_rows=2)
+    assert led.per_model()["a"]["device_seconds"] == pytest.approx(1.5)
+    assert led.per_model()["a"]["goodput_rows"] == pytest.approx(4)
+    assert led.totals()["goodput_rows"] == pytest.approx(6)
+    assert sorted(led.models()) == ["a", "b"]
+
+
+def test_ledger_registry_export_absent_not_zero():
+    reg = MetricsRegistry()
+    led = AttributionLedger()
+    led.register(reg)
+    led.charge("a", device_seconds=0.25, device_flops=100.0)
+    led.set_staging_bytes("a", 2048)
+    from keystone_tpu.observability import prometheus
+
+    body = prometheus.render(reg.collect())
+    assert (
+        'keystone_attr_device_seconds_total{model="a"} 0.25' in body
+    )
+    assert (
+        'keystone_attr_device_flops_total{model="a"} 100' in body
+    )
+    assert 'keystone_attr_staging_bytes{model="a"} 2048' in body
+    # never-charged fields stay ABSENT for the model, not zero
+    assert 'keystone_attr_h2d_bytes_total{model="a"}' not in body
+
+
+# -- EngineAttribution: the sum invariant ----------------------------------
+
+
+def _totals_match(led, expect):
+    totals = led.totals()
+    for field, want in expect.items():
+        got = totals[field]
+        rel = abs(got - want) / abs(want) if want else abs(got)
+        assert rel <= 1e-6, (field, got, want)
+
+
+def test_solo_engine_charges_everything_to_its_model():
+    led = AttributionLedger()
+    binding = EngineAttribution(led, ["only"])
+    binding.on_dispatch(8, n_valid=5, padded=3, flops=1000.0,
+                        seconds=0.5, h2d_bytes=64)
+    assert led.per_model()["only"]["goodput_rows"] == pytest.approx(5)
+    assert led.per_model()["only"]["padded_rows"] == pytest.approx(3)
+    assert led.per_model()["only"]["device_seconds"] == pytest.approx(0.5)
+
+
+def test_shared_engine_row_share_split_sums_exactly():
+    """Without split cost models the fair split degrades to pure row
+    share — and per-model charges still sum EXACTLY to what the engine
+    recorded, whatever the interleaving."""
+    led = AttributionLedger()
+    q = RowClaimQueue()
+    binding = EngineAttribution(led, ["a", "b"], shares_fn=q.drain)
+    totals = {f: 0.0 for f in CELL_FIELDS}
+    for i in range(7):
+        q.claim("a", 2)
+        q.claim("b", 1)
+        binding.on_dispatch(4, n_valid=3, padded=1,
+                            flops=100.0 * (i + 1), seconds=0.01 * i,
+                            h2d_bytes=96)
+        totals["goodput_rows"] += 3
+        totals["padded_rows"] += 1
+        totals["dispatches"] += 1
+        totals["device_flops"] += 100.0 * (i + 1)
+        totals["device_seconds"] += 0.01 * i
+        totals["h2d_bytes"] += 96
+    _totals_match(led, totals)
+    # 2:1 row claims -> 2:1 goodput
+    assert led.per_model()["a"]["goodput_rows"] == pytest.approx(14)
+    assert led.per_model()["b"]["goodput_rows"] == pytest.approx(7)
+
+
+def test_shared_engine_split_cost_fair_split():
+    """With a split cost model, the shared prefix's FLOPs are
+    apportioned by row share while each head's own FLOPs stay with its
+    model: w[m] = rowshare[m] * prefix + head[m], normalized. The sum
+    invariant must hold bit-for-bit regardless."""
+    led = AttributionLedger()
+    q = RowClaimQueue()
+    binding = EngineAttribution(
+        led, ["a", "b"], shares_fn=q.drain,
+        # prefix 1000 FLOPs, head a 300, head b 100
+        split_cost_fn=lambda bucket: (1000.0, {"a": 300.0, "b": 100.0}),
+    )
+    q.claim("a", 3)
+    q.claim("b", 1)
+    binding.on_dispatch(4, n_valid=4, padded=0, flops=1400.0,
+                        seconds=1.0, h2d_bytes=0)
+    # w_a = 0.75*1000 + 300 = 1050; w_b = 0.25*1000 + 100 = 350
+    assert led.per_model()["a"]["device_flops"] == pytest.approx(
+        1400.0 * 1050 / 1400
+    )
+    assert led.per_model()["b"]["device_flops"] == pytest.approx(
+        1400.0 * 350 / 1400
+    )
+    assert led.per_model()["a"]["device_seconds"] == pytest.approx(0.75)
+    _totals_match(led, {"device_flops": 1400.0, "device_seconds": 1.0,
+                        "goodput_rows": 4.0, "dispatches": 1.0})
+
+
+def test_pending_seconds_split_on_complete():
+    """The pipelined path reports seconds at completion, not dispatch:
+    the binding must remember the dispatched windows' weights and
+    split the completion-timed seconds with THEM, not with whatever
+    the claim queue holds by then. One completion covers EVERY
+    dispatch since the last sync point, so a two-window sync splits
+    by the summed weights."""
+    led = AttributionLedger()
+    q = RowClaimQueue()
+    binding = EngineAttribution(led, ["a", "b"], shares_fn=q.drain)
+    q.claim("a", 4)
+    binding.on_dispatch(4, n_valid=4, padded=0, flops=0.0,
+                        seconds=None, h2d_bytes=0)
+    q.claim("b", 4)
+    q.claim("b", 4)
+    binding.on_dispatch(8, n_valid=8, padded=0, flops=0.0,
+                        seconds=None, h2d_bytes=0)
+    # windows a:1.0 and b:1.0 pending -> the 1.5 s covering both
+    # splits evenly, untouched by whatever was claimed afterwards
+    q.claim("a", 100)
+    binding.on_complete(1.5)
+    assert led.per_model()["a"]["device_seconds"] == pytest.approx(0.75)
+    assert led.per_model()["b"]["device_seconds"] == pytest.approx(0.75)
+    _totals_match(led, {"device_seconds": 1.5})
+
+
+def test_per_window_completions_pair_with_their_dispatch():
+    """Serial lanes sync once per window: dispatch -> complete ->
+    dispatch -> complete keeps each window's seconds with that
+    window's models."""
+    led = AttributionLedger()
+    q = RowClaimQueue()
+    binding = EngineAttribution(led, ["a", "b"], shares_fn=q.drain)
+    q.claim("a", 4)
+    binding.on_dispatch(4, n_valid=4, padded=0, flops=0.0,
+                        seconds=None, h2d_bytes=0)
+    binding.on_complete(1.0)
+    q.claim("b", 4)
+    binding.on_dispatch(4, n_valid=4, padded=0, flops=0.0,
+                        seconds=None, h2d_bytes=0)
+    binding.on_complete(0.5)
+    assert led.per_model()["a"]["device_seconds"] == pytest.approx(1.0)
+    assert led.per_model()["b"]["device_seconds"] == pytest.approx(0.5)
+    _totals_match(led, {"device_seconds": 1.5})
+
+
+# -- documents -------------------------------------------------------------
+
+
+def test_attribution_document_shares_and_topk():
+    led = AttributionLedger()
+    led.charge("a", device_seconds=3.0, device_flops=3e9,
+               goodput_rows=30, padded_rows=0, dispatches=3)
+    led.charge("b", device_seconds=1.0, device_flops=1e9,
+               goodput_rows=5, padded_rows=5, dispatches=1)
+    doc = attribution_document(led, top_k=1)
+    assert doc["totals"]["device_seconds"] == pytest.approx(4.0)
+    a = doc["models"]["a"]
+    assert a["device_seconds_share"] == pytest.approx(0.75)
+    assert a["goodput_fraction"] == pytest.approx(1.0)
+    assert doc["models"]["b"]["goodput_fraction"] == pytest.approx(0.5)
+    assert math.isclose(
+        sum(m["device_seconds_share"]
+            for m in doc["models"].values()),
+        1.0,
+    )
+    assert len(doc["top"]) == 1 and doc["top"][0]["model"] == "a"
+
+
+def test_attribution_from_samples_round_trips():
+    """The admin endpoint and the fleet router rebuild the document
+    from exported samples — the reconstruction must agree with the
+    ledger's own document."""
+    reg = MetricsRegistry()
+    led = AttributionLedger()
+    led.register(reg)
+    led.charge("a", device_seconds=2.0, device_flops=4e9,
+               goodput_rows=20, dispatches=2, h2d_bytes=512)
+    led.charge("b", device_seconds=2.0, goodput_rows=10, dispatches=1)
+    led.set_staging_bytes("a", 4096)
+    from keystone_tpu.observability import prometheus
+
+    samples = prometheus.parse_samples(
+        prometheus.render(reg.collect())
+    )
+    rebuilt = attribution_from_samples(samples)
+    direct = attribution_document(led)
+    assert rebuilt["totals"] == direct["totals"]
+    assert rebuilt["models"]["a"]["device_seconds"] == pytest.approx(
+        direct["models"]["a"]["device_seconds"]
+    )
+    assert rebuilt["models"]["a"]["staging_bytes"] == 4096
+    assert "staging_bytes" not in rebuilt["models"]["b"]
+
+
+def test_attribution_from_samples_ignores_foreign_families():
+    rebuilt = attribution_from_samples(
+        [("keystone_gateway_inflight", {"gateway": "g"}, 3.0)]
+    )
+    assert rebuilt["models"] == {}

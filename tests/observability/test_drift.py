@@ -1,0 +1,162 @@
+"""Live-histogram drift detection: the PSI math, windowed
+observation, absent-not-zero scoring (no baseline / too few rows),
+threshold flagging with flight-recorder capture, and the registry
+export."""
+
+import pytest
+
+from keystone_tpu.observability.drift import (
+    DEFAULT_THRESHOLD,
+    DriftDetector,
+    psi,
+)
+from keystone_tpu.observability.flight import FlightRecorder
+from keystone_tpu.observability.registry import MetricsRegistry
+
+
+# -- psi -------------------------------------------------------------------
+
+
+def test_psi_identical_distributions_is_zero():
+    assert psi({1: 80, 2: 20}, {1: 40, 2: 10}) == pytest.approx(
+        0.0, abs=1e-9
+    )
+
+
+def test_psi_grows_with_divergence():
+    base = {1: 80, 2: 20}
+    mild = psi(base, {1: 70, 2: 30})
+    wild = psi(base, {1: 10, 2: 90})
+    assert 0 < mild < wild
+
+
+def test_psi_disjoint_support_is_large():
+    # a full population swap must land far past any sane threshold
+    assert psi({1: 100}, {32: 100}) > 1.0
+
+
+def test_psi_empty_inputs_are_none():
+    assert psi({}, {1: 10}) is None
+    assert psi({1: 10}, {}) is None
+    assert psi({}, {}) is None
+
+
+def test_psi_symmetric_in_magnitude():
+    a, b = {1: 90, 8: 10}, {1: 10, 8: 90}
+    assert psi(a, b) == pytest.approx(psi(b, a))
+
+
+# -- DriftDetector ---------------------------------------------------------
+
+
+def _detector(**kw):
+    kw.setdefault("min_rows", 4)
+    clock = {"t": 0.0}
+    det = DriftDetector(clock=lambda: clock["t"], **kw)
+    return det, clock
+
+
+def test_no_baseline_means_no_score():
+    det, _ = _detector()
+    for _ in range(10):
+        det.observe("m", 1)
+    assert det.scores() == {}
+    assert det.drifted() == []
+
+
+def test_too_few_rows_means_no_score():
+    det, _ = _detector(min_rows=8)
+    det.set_baseline("m", {1: 80, 2: 20})
+    for _ in range(7):
+        det.observe("m", 1)
+    assert "m" not in det.scores()
+    det.observe("m", 1)
+    assert "m" in det.scores()
+
+
+def test_matching_traffic_scores_low_and_shifted_high():
+    det, _ = _detector()
+    det.set_baseline("m", {1: 80, 2: 20})
+    for _ in range(8):
+        det.observe("m", 1)
+    for _ in range(2):
+        det.observe("m", 2)
+    assert det.scores()["m"] < 0.1
+    det2, _ = _detector()
+    det2.set_baseline("m", {1: 100})
+    for _ in range(10):
+        det2.observe("m", 32)
+    assert det2.scores()["m"] > DEFAULT_THRESHOLD
+    assert det2.drifted() == ["m"]
+
+
+def test_window_prunes_old_observations():
+    det, clock = _detector(window_s=10.0)
+    det.set_baseline("m", {1: 100})
+    for _ in range(6):
+        det.observe("m", 32)  # t=0: shifted traffic
+    clock["t"] = 11.0  # the shifted burst ages out of the window
+    for _ in range(6):
+        det.observe("m", 1)  # matching traffic again
+    assert det.scores()["m"] < 0.1
+    assert det.live_histogram("m") == {1: 6}
+
+
+def test_flight_capture_on_threshold_entry_only():
+    """Crossing the threshold captures ONE forensic record (reason
+    ``drift``); staying over it must not spam the ring."""
+    reg = MetricsRegistry()
+    flight = FlightRecorder(registry=reg)
+    det, _ = _detector(flight=flight)
+    det.set_baseline("m", {1: 100})
+    for _ in range(4):
+        det.observe("m", 32)
+    det.scores()
+    det.observe("m", 32)
+    det.scores()  # still drifted: no second record
+    records = [r for r in flight.records() if r.reason == "drift"]
+    assert len(records) == 1
+    assert records[0].attrs["model"] == "m"
+    assert records[0].attrs["psi"] > DEFAULT_THRESHOLD
+
+
+def test_clearing_baseline_clears_score_and_flag():
+    det, _ = _detector()
+    det.set_baseline("m", {1: 100})
+    for _ in range(4):
+        det.observe("m", 32)
+    assert det.drifted() == ["m"]
+    det.set_baseline("m", {})
+    assert det.scores() == {}
+    assert det.drifted() == []
+
+
+def test_registry_export_absent_until_scoreable():
+    from keystone_tpu.observability import prometheus
+
+    reg = MetricsRegistry()
+    det, _ = _detector()
+    det.register(reg)
+    det.set_baseline("m", {1: 100})
+    body = prometheus.render(reg.collect())
+    # metadata may render, but no SAMPLE exists until scoreable
+    assert "keystone_drift_score{" not in body
+    for _ in range(4):
+        det.observe("m", 32)
+    body = prometheus.render(reg.collect())
+    assert 'keystone_drift_score{model="m"}' in body
+
+
+def test_document_shape():
+    det, _ = _detector()
+    det.set_baseline("m", {1: 100})
+    for _ in range(4):
+        det.observe("m", 1)
+    doc = det.document()
+    assert doc["threshold"] == DEFAULT_THRESHOLD
+    assert doc["min_rows"] == 4
+    assert doc["scores"]["m"] == pytest.approx(0.0, abs=1e-6)
+    assert doc["drifted"] == []
+    # histogram keys are stringified — the document is JSON-bound
+    assert doc["baselines"]["m"] == {"1": 100.0}
+    assert doc["live"]["m"] == {"1": 4}

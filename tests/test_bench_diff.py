@@ -1,0 +1,119 @@
+"""bench-diff: round-over-round regression gating off bench JSONs —
+direction inference per unit, per-row tolerance resolution, vanished/
+new/skipped verdicts, and the nonzero exit contract."""
+
+import json
+
+from keystone_tpu.bench_diff import classify, diff_rows, load_rows, main
+
+
+def _row(metric, value, unit, **extra):
+    return {"metric": metric, "value": value, "unit": unit, **extra}
+
+
+def _index(*rows):
+    return {r["metric"]: r for r in rows}
+
+
+def test_classify_directions():
+    assert classify("ms")[0] == "up"
+    assert classify("examples/sec")[0] == "down"
+    assert classify("x")[0] == "down"
+    assert classify("weird_unit") is None
+
+
+def test_latency_regression_flags_and_rate_regression_flags():
+    old = _index(_row("p99", 10.0, "ms"), _row("rate", 100.0,
+                                               "examples/sec"))
+    new = _index(_row("p99", 14.0, "ms"), _row("rate", 80.0,
+                                               "examples/sec"))
+    verdicts = {e["metric"]: e["verdict"]
+                for e in diff_rows(old, new)}
+    assert verdicts == {"p99": "regressed", "rate": "regressed"}
+
+
+def test_within_tolerance_is_ok_and_direction_matters():
+    old = _index(_row("p99", 10.0, "ms"), _row("rate", 100.0,
+                                               "examples/sec"))
+    # latency DOWN and rate UP are improvements, never regressions
+    new = _index(_row("p99", 5.0, "ms"), _row("rate", 200.0,
+                                              "examples/sec"))
+    verdicts = {e["metric"]: e["verdict"]
+                for e in diff_rows(old, new)}
+    assert verdicts == {"p99": "improved", "rate": "improved"}
+    new = _index(_row("p99", 10.5, "ms"), _row("rate", 95.0,
+                                               "examples/sec"))
+    verdicts = {e["metric"]: e["verdict"]
+                for e in diff_rows(old, new)}
+    assert verdicts == {"p99": "ok", "rate": "ok"}
+
+
+def test_tolerance_resolution_order():
+    old = _index(_row("p99", 10.0, "ms"))
+    new = _index(_row("p99", 14.0, "ms"))
+    # explicit override beats everything
+    assert diff_rows(old, new, overrides={"p99": 0.5})[0][
+        "verdict"] == "ok"
+    # the row's own embedded tolerance beats the global flag
+    new_tol = _index(_row("p99", 14.0, "ms", tolerance=0.5))
+    assert diff_rows(old, new_tol, tolerance=0.01)[0][
+        "verdict"] == "ok"
+    # the global flag beats the unit-class default
+    assert diff_rows(old, new, tolerance=0.5)[0]["verdict"] == "ok"
+
+
+def test_vanished_new_and_skipped_rows():
+    old = _index(_row("gone", 1.0, "x"),
+                 _row("skip", None, "skipped", skipped=True))
+    new = _index(_row("fresh", 2.0, "x"),
+                 _row("skip", None, "skipped", skipped=True))
+    verdicts = {e["metric"]: e["verdict"]
+                for e in diff_rows(old, new)}
+    assert verdicts == {"gone": "vanished", "fresh": "new",
+                        "skip": "skipped"}
+
+
+def test_uncomparable_units_never_gate():
+    old = _index(_row("odd", 1.0, "widgets"))
+    new = _index(_row("odd", 100.0, "widgets"))
+    assert diff_rows(old, new)[0]["verdict"] == "uncomparable"
+
+
+def test_load_rows_jsonl_array_and_log_noise(tmp_path):
+    rows = [_row("a", 1.0, "ms"), _row("b", 2.0, "x")]
+    jsonl = tmp_path / "r.jsonl"
+    jsonl.write_text(
+        "some log line\n"
+        + "\n".join(json.dumps(r) for r in rows)
+        + "\nnot json either\n"
+    )
+    assert set(load_rows(str(jsonl))) == {"a", "b"}
+    arr = tmp_path / "r.json"
+    arr.write_text(json.dumps(rows))
+    assert set(load_rows(str(arr))) == {"a", "b"}
+    # duplicate metrics: first row wins (the emitters' guard)
+    dup = tmp_path / "dup.jsonl"
+    dup.write_text(json.dumps(_row("a", 1.0, "ms")) + "\n"
+                   + json.dumps(_row("a", 9.0, "ms")) + "\n")
+    assert load_rows(str(dup))["a"]["value"] == 1.0
+
+
+def test_main_exit_codes(tmp_path, capsys):
+    old = tmp_path / "old.jsonl"
+    new = tmp_path / "new.jsonl"
+    old.write_text(json.dumps(_row("p99", 10.0, "ms")) + "\n")
+    new.write_text(json.dumps(_row("p99", 14.0, "ms")) + "\n")
+    assert main([str(old), str(new)]) == 1
+    assert main([str(old), str(new), "--tolerance", "0.5"]) == 0
+    assert main([str(old), str(new), "--set", "p99=0.5"]) == 0
+    # missing new-side metric fails unless --allow-missing
+    new.write_text(json.dumps(_row("other", 1.0, "x")) + "\n")
+    assert main([str(old), str(new)]) == 1
+    assert main([str(old), str(new), "--allow-missing"]) == 0
+    capsys.readouterr()
+    # unreadable / empty inputs are usage errors, not crashes
+    assert main([str(tmp_path / "nope.json"), str(new)]) == 2
+    empty = tmp_path / "empty.json"
+    empty.write_text("")
+    assert main([str(empty), str(new)]) == 2
+    capsys.readouterr()

@@ -278,3 +278,77 @@ def test_closed_zoo_rejects_work():
     assert zoo.ready is False
     with pytest.raises(RuntimeError, match="closed"):
         zoo.predict(np.zeros(D, np.float32))
+
+
+def _goodput(zoo):
+    per = zoo.attribution.per_model()
+    return {m: cell["goodput_rows"] for m, cell in per.items()}
+
+
+def _engine_examples(zoo, mid):
+    return sum(
+        lane.engine.metrics.examples.total
+        for lane in zoo.gateway_for(mid).pool.lanes
+    )
+
+
+def test_predict_many_shared_unit_accounts_each_model_once():
+    """One ``predict_many`` over a co-hosted pair is ONE submit to the
+    shared unit: the engine sees exactly one admitted row, and the
+    ledger charges each member its even split of that single row —
+    never a full row per member (double counting) and never zero."""
+    feat, feat_d = build_featurize_pipeline(img=IMG)
+    heads = {
+        "alpha": build_pipeline(d=feat_d, hidden=8, depth=2, seed=1),
+        "beta": build_pipeline(d=feat_d, hidden=8, depth=2, seed=2),
+    }
+
+    def spec(mid, default=False):
+        return ModelSpec(
+            model_id=mid,
+            build=lambda h=heads[mid]: BuiltModel(
+                fitted=h, featurize=feat
+            ),
+            buckets=(2, 4),
+            lanes=1,
+            max_delay_ms=1.0,
+            input_dtype=np.uint8,
+            default=default,
+        )
+
+    with _zoo([spec("alpha", True), spec("beta")], cse=True) as zoo:
+        zoo.host()
+        rng = np.random.default_rng(7)
+        x = rng.integers(0, 256, (IMG, IMG, 3), dtype=np.uint8)
+        zoo.predict_many(x).result(timeout=60)  # warm compile path
+        rows0 = _engine_examples(zoo, "alpha")
+        good0 = _goodput(zoo)
+        out = zoo.predict_many(x).result(timeout=60)
+        assert sorted(out) == ["alpha", "beta"]
+        assert _engine_examples(zoo, "alpha") == rows0 + 1
+        good = _goodput(zoo)
+        assert good["alpha"] - good0.get("alpha", 0) == pytest.approx(0.5)
+        assert good["beta"] - good0.get("beta", 0) == pytest.approx(0.5)
+        # and the sum invariant survives: ledger total == engine total
+        assert sum(good.values()) == pytest.approx(
+            _engine_examples(zoo, "alpha")
+        )
+
+
+def test_predict_many_solo_units_account_each_model_once():
+    """Across SOLO units the fan-out is one submit per unit: each
+    model's engine admits one row and each model's ledger account is
+    charged exactly one full row."""
+    spec_a, _ = _solo_spec("alpha", 1, default=True)
+    spec_b, _ = _solo_spec("beta", 2)
+    with _zoo([spec_a, spec_b]) as zoo:
+        x = np.linspace(-1, 1, D).astype(np.float32)
+        zoo.predict_many(x).result(timeout=60)  # warm compile path
+        rows0 = {m: _engine_examples(zoo, m) for m in ("alpha", "beta")}
+        good0 = _goodput(zoo)
+        zoo.predict_many(x).result(timeout=60)
+        for mid in ("alpha", "beta"):
+            assert _engine_examples(zoo, mid) == rows0[mid] + 1
+            assert _goodput(zoo)[mid] - good0.get(mid, 0) == (
+                pytest.approx(1.0)
+            )
